@@ -1,0 +1,31 @@
+"""CLI entry point: ``python main.py --feature_type <X> ...``
+
+Drop-in surface for the reference CLI (ref main.py:94-149): same flags,
+same feature types, same output contract. ``--device_ids`` indexes
+``jax.devices()`` (TPU chips under TPU runtimes); ``--cpu`` forces the CPU
+backend. Dispatch goes through one code path — the dynamic work-queue
+scheduler — for both single- and multi-device runs.
+"""
+
+import sys
+
+from video_features_tpu.config import parse_args
+from video_features_tpu.extract.registry import build_extractor
+from video_features_tpu.parallel.devices import resolve_devices
+from video_features_tpu.parallel.scheduler import parallel_feature_extraction
+
+
+def main(argv=None) -> None:
+    cfg = parse_args(argv)
+    if cfg.on_extraction in ("save_numpy", "save_pickle"):
+        print(f"Saving features to {cfg.output_path}")
+    if cfg.keep_tmp_files:
+        print(f"Keeping temp files in {cfg.tmp_path}")
+
+    extractor = build_extractor(cfg)
+    devices = resolve_devices(cfg)
+    parallel_feature_extraction(extractor, devices)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,62 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+Must run before jax is imported anywhere: force the CPU platform and 8
+virtual host devices so multi-chip sharding tests run without a TPU pod
+(SURVEY.md §4c). The real-chip benchmark path is exercised separately by
+bench.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def sample_video(tmp_path_factory):
+    """A small deterministic synthetic mp4 (moving gradient + box)."""
+    import cv2
+
+    path = str(tmp_path_factory.mktemp("media") / "synth.mp4")
+    w, h, fps, n = 320, 240, 25.0, 60
+    writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+    assert writer.isOpened(), "cv2.VideoWriter could not open mp4 writer"
+    rng = np.random.RandomState(0)
+    for t in range(n):
+        yy, xx = np.mgrid[0:h, 0:w]
+        frame = np.stack(
+            [
+                ((xx + 2 * t) % 256),
+                ((yy + t) % 256),
+                np.full((h, w), (t * 4) % 256),
+            ],
+            axis=-1,
+        ).astype(np.uint8)
+        x0 = (10 + 3 * t) % (w - 40)
+        y0 = (20 + 2 * t) % (h - 40)
+        frame[y0 : y0 + 30, x0 : x0 + 30] = rng.randint(0, 255, 3)
+        writer.write(frame)
+    writer.release()
+    return path
+
+
+@pytest.fixture(scope="session")
+def sample_wav(tmp_path_factory):
+    """1.5 s stereo 44.1 kHz wav with two tones."""
+    from scipy.io import wavfile
+
+    path = str(tmp_path_factory.mktemp("media") / "synth.wav")
+    sr = 44100
+    t = np.arange(int(1.5 * sr)) / sr
+    left = 0.5 * np.sin(2 * np.pi * 440 * t)
+    right = 0.3 * np.sin(2 * np.pi * 1000 * t)
+    data = (np.stack([left, right], axis=1) * 32767).astype(np.int16)
+    wavfile.write(path, sr, data)
+    return path

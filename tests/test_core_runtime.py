@@ -1,0 +1,188 @@
+"""Core runtime: config shim, path lists, slices, sink, video/audio IO."""
+
+import argparse
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig, parse_args, sanity_check
+from video_features_tpu.io.audio import read_wav, resample, to_mono
+from video_features_tpu.io.paths import form_list_from_user_input, form_slices
+from video_features_tpu.io.sink import action_on_extraction
+from video_features_tpu.io.video import extract_frames, probe, read_all_frames, stream_frames
+from video_features_tpu.utils.labels import load_classes, show_predictions_on_dataset
+
+
+# --- config ---------------------------------------------------------------
+
+def test_parse_args_reference_surface():
+    cfg = parse_args(
+        ["--feature_type", "CLIP-ViT-B/32", "--cpu", "--extract_method", "uni_12",
+         "--on_extraction", "save_numpy"]
+    )
+    assert cfg.feature_type == "CLIP-ViT-B/32"
+    assert cfg.cpu is True
+    assert cfg.extract_method == "uni_12"
+    assert cfg.on_extraction == "save_numpy"
+    assert cfg.batch_size == 1
+    assert cfg.flow_type == "pwc"
+
+
+def test_from_namespace_accepts_reference_style_namespace():
+    ns = argparse.Namespace(
+        feature_type="resnet50", video_paths=["x.mp4"], batch_size=8,
+        device_ids=[0, 1], some_unknown_key="ignored", extraction_fps=None,
+    )
+    cfg = ExtractionConfig.from_namespace(ns)
+    assert cfg.feature_type == "resnet50"
+    assert cfg.batch_size == 8
+    assert cfg.device_ids == [0, 1]
+    assert cfg.extraction_fps is None
+
+
+def test_sanity_check_rejects_same_out_and_tmp():
+    with pytest.raises(AssertionError):
+        sanity_check(ExtractionConfig(output_path="./x", tmp_path="./x"))
+
+
+def test_sanity_check_i3d_stack_size():
+    with pytest.raises(AssertionError):
+        sanity_check(ExtractionConfig(feature_type="i3d", stack_size=5))
+    sanity_check(ExtractionConfig(feature_type="i3d", stack_size=24))
+
+
+def test_show_pred_pins_one_device():
+    cfg = sanity_check(ExtractionConfig(show_pred=True, device_ids=[2, 3]))
+    assert cfg.device_ids == [2]
+
+
+# --- paths / slices -------------------------------------------------------
+
+def test_form_slices_matches_reference_windowing():
+    # ref utils/utils.py:117-126 drops the ragged tail
+    assert form_slices(100, 15, 15) == [(i * 15, i * 15 + 15) for i in range(6)]
+    assert form_slices(64, 64, 64) == [(0, 64)]
+    assert form_slices(63, 64, 64) == []
+    assert form_slices(10, 4, 2) == [(0, 4), (2, 6), (4, 8), (6, 10)]
+
+
+def test_form_list_file_with_paths(tmp_path, sample_video):
+    listing = tmp_path / "paths.txt"
+    listing.write_text(f"{sample_video}\n\n{sample_video}\n")
+    cfg = ExtractionConfig(file_with_video_paths=str(listing))
+    assert form_list_from_user_input(cfg) == [sample_video, sample_video]
+
+
+def test_form_list_video_dir_with_flow_dir_pairs(tmp_path):
+    vdir, fdir = tmp_path / "v", tmp_path / "f"
+    vdir.mkdir(), fdir.mkdir()
+    (vdir / "a.mp4").write_bytes(b"x")
+    (vdir / "b.mp4").write_bytes(b"x")
+    (fdir / "a").mkdir()
+    cfg = ExtractionConfig(video_dir=str(vdir), flow_dir=str(fdir))
+    pairs = form_list_from_user_input(cfg)
+    assert pairs == [(str(vdir / "a.mp4"), str(fdir / "a"))]
+
+
+def test_form_list_missing_path_raises():
+    cfg = ExtractionConfig(video_paths=["/definitely/not/here.mp4"])
+    with pytest.raises(ValueError):
+        form_list_from_user_input(cfg)
+
+
+# --- sink -----------------------------------------------------------------
+
+def test_sink_save_numpy_and_pickle_naming(tmp_path):
+    feats = {"clip": np.ones((3, 4), np.float32), "fps": 25.0, "timestamps_ms": [0.0]}
+    action_on_extraction(feats, "/x/video1.mp4", str(tmp_path), "save_numpy")
+    assert (tmp_path / "video1_clip.npy").exists()
+    assert not (tmp_path / "video1_fps.npy").exists()
+    loaded = np.load(tmp_path / "video1_clip.npy")
+    np.testing.assert_array_equal(loaded, feats["clip"])
+
+    action_on_extraction(feats, "/x/video1.mp4", str(tmp_path), "save_pickle",
+                         output_direct=True)
+    with open(tmp_path / "video1.pkl", "rb") as f:
+        np.testing.assert_array_equal(pickle.load(f), feats["clip"])
+
+
+def test_sink_save_jpg_flow(tmp_path):
+    flow = np.random.RandomState(0).randint(0, 255, (2, 2, 8, 8)).astype(np.float32)
+    action_on_extraction({"raft": flow}, "v.mp4", str(tmp_path), "save_jpg")
+    assert sorted(os.listdir(tmp_path / "v")) == [
+        "00000_x.jpg", "00000_y.jpg", "00001_x.jpg", "00001_y.jpg"
+    ]
+
+
+def test_sink_print_runs(capsys):
+    action_on_extraction({"f": np.arange(4.0)}, "v.mp4", ".", "print")
+    out = capsys.readouterr().out
+    assert "max: 3.0" in out and "mean: 1.5" in out
+
+
+# --- video IO -------------------------------------------------------------
+
+def test_probe_and_stream(sample_video):
+    meta = probe(sample_video)
+    assert meta.frame_count == 60
+    assert abs(meta.fps - 25.0) < 1e-6
+    frames = list(stream_frames(sample_video))
+    assert len(frames) == 60
+    frame0, ts0 = frames[0]
+    assert frame0.shape == (240, 320, 3) and frame0.dtype == np.uint8
+    assert ts0 == 0.0
+    assert abs(frames[1][1] - 40.0) < 1e-6  # 1000/25
+
+
+def test_stream_frames_fps_retarget(sample_video):
+    frames = list(stream_frames(sample_video, extraction_fps=5.0))
+    # 60 frames @25fps = 2.4s -> 12 frames @5fps
+    assert len(frames) == 12
+    assert abs(frames[1][1] - 200.0) < 1e-6
+
+
+def test_read_all_frames(sample_video):
+    frames, fps, stamps = read_all_frames(sample_video)
+    assert len(frames) == 60 and len(stamps) == 60
+    assert abs(fps - 25.0) < 1e-6
+
+
+def test_extract_frames_uni_and_fix(sample_video):
+    frames, fps, ts = extract_frames(sample_video, "uni_12")
+    assert len(frames) == 12 and len(ts) == 12
+    # linspace(1, 58, 12) endpoints
+    assert abs(ts[0] - 1000.0 / 25.0) < 1e-6
+    assert abs(ts[-1] - 58 * 1000.0 / 25.0) < 1e-6
+
+    frames, fps, ts = extract_frames(sample_video, "fix_5")
+    assert len(frames) == 12  # int(60/25*5)
+
+
+# --- audio IO -------------------------------------------------------------
+
+def test_audio_roundtrip(sample_wav):
+    data, sr = read_wav(sample_wav)
+    assert sr == 44100 and data.ndim == 2
+    mono = to_mono(data)
+    assert mono.ndim == 1
+    res = resample(mono, sr, 16000)
+    expected = int(round(len(mono) * 16000 / 44100))
+    assert abs(len(res) - expected) <= 2
+    # a 440 Hz tone must survive resampling: check dominant frequency
+    spec = np.abs(np.fft.rfft(res * np.hanning(len(res))))
+    freq = np.fft.rfftfreq(len(res), 1 / 16000)
+    assert abs(freq[spec.argmax()] - 440) < 5
+
+
+# --- labels ---------------------------------------------------------------
+
+def test_labels_load_and_show(capsys):
+    assert len(load_classes("imagenet")) == 1000
+    assert len(load_classes("kinetics")) == 400
+    logits = np.zeros((1, 1000), np.float32)
+    logits[0, 3] = 10.0
+    show_predictions_on_dataset(logits, "imagenet")
+    out = capsys.readouterr().out
+    assert load_classes("imagenet")[3] in out

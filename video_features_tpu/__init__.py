@@ -1,0 +1,22 @@
+"""TPU-native video feature extraction framework.
+
+A ground-up JAX/XLA/Flax/Pallas rebuild of the capabilities of
+Kamino666/video_features (reference: /root/reference): per-video visual
+(CLIP ViT, ResNet, I3D, R(2+1)D), optical-flow (RAFT, PWC-Net) and audio
+(VGGish) features from pretrained nets, data-parallel across accelerator
+chips.
+
+Design stance (see SURVEY.md §7): the reference's *contracts* are kept —
+CLI flags and feature types (ref main.py:94-137), the output dict
+``{feature_type, 'fps', 'timestamps_ms'}`` routed through an output sink
+(ref utils/utils.py:50-114), per-video error isolation, and the
+external-call API. The *internals* are TPU-first: Flax modules compiled
+once per device with ``jax.jit`` on bucketed static shapes, a host-side
+decode/prefetch pipeline feeding device queues, XLA collectives over a
+``jax.sharding.Mesh`` for the batched multi-chip path, and Pallas kernels
+for the reference's custom CUDA ops.
+"""
+
+__version__ = "0.1.0"
+
+from video_features_tpu.config import ExtractionConfig, build_arg_parser  # noqa: F401

@@ -1,0 +1,182 @@
+"""Typed configuration + CLI shim.
+
+The reference passes a raw ``argparse.Namespace`` (ref main.py:94-137) into
+every extractor. Here the canonical object is a typed dataclass; an
+argparse parser with the reference's exact flag surface builds it, and a
+``from_namespace`` shim accepts reference-style namespaces so external
+callers (ref README.md:38-57) can migrate without changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+FEATURE_TYPES = [
+    "i3d",
+    "vggish",
+    "vggish_torch",
+    "r21d_rgb",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "raft",
+    "pwc",
+    "CLIP-ViT-B/32",
+    "CLIP-ViT-B/16",
+    "CLIP4CLIP-ViT-B-32",
+]
+
+RESNET_FEATURE_TYPES = [f"resnet{d}" for d in (18, 34, 50, 101, 152)]
+CLIP_FEATURE_TYPES = ["CLIP-ViT-B/32", "CLIP-ViT-B/16", "CLIP4CLIP-ViT-B-32"]
+
+
+@dataclass
+class ExtractionConfig:
+    """All knobs for one extraction job.
+
+    Field names intentionally match the reference CLI flags
+    (ref main.py:94-137) so ``ExtractionConfig(**vars(args))`` works.
+    """
+
+    feature_type: str = "CLIP-ViT-B/32"
+
+    # --- input selection (ref utils/utils.py:153-204) ---
+    video_paths: Optional[List[str]] = None
+    flow_paths: Optional[List[str]] = None
+    file_with_video_paths: Optional[str] = None
+    video_dir: Optional[str] = None
+    flow_dir: Optional[str] = None
+
+    # --- devices ---
+    device_ids: Optional[List[int]] = None
+    cpu: bool = False
+
+    # --- output ---
+    tmp_path: str = "./tmp"
+    keep_tmp_files: bool = False
+    on_extraction: str = "print"  # print | save_numpy | save_pickle
+    output_path: str = "./output"
+    output_direct: bool = False
+
+    # --- sampling / windowing ---
+    extraction_fps: Optional[float] = None
+    extract_method: Optional[str] = None  # e.g. 'fix_2', 'uni_12'
+    stack_size: Optional[int] = None
+    step_size: Optional[int] = None
+    streams: Optional[List[str]] = None  # subset of ['rgb', 'flow']
+    flow_type: str = "pwc"  # raft | pwc | flow (pre-extracted)
+    batch_size: int = 1
+    resize_to_smaller_edge: bool = True
+    side_size: Optional[int] = None
+
+    # --- debug rails ---
+    show_pred: bool = False
+
+    # --- TPU-native knobs (no reference equivalent) ---
+    # Numerics: 'float32' for parity with the fp32 reference; 'bfloat16'
+    # for MXU throughput once parity is established.
+    dtype: str = "float32"
+    # Path to converted model weights (.npz / orbax dir). None -> use
+    # deterministic random init (weights cannot be downloaded offline).
+    weights_path: Optional[str] = None
+    # Host-side decode worker threads feeding each device queue.
+    decode_workers: int = 2
+    # Resolution buckets for XLA static shapes (see ops/window.py).
+    shape_buckets: Optional[List[int]] = None
+
+    def __post_init__(self) -> None:
+        if self.streams is not None and not isinstance(self.streams, (list, tuple)):
+            self.streams = [self.streams]
+
+    @classmethod
+    def from_namespace(cls, args: argparse.Namespace) -> "ExtractionConfig":
+        """Accept a reference-style argparse.Namespace (extra keys ignored,
+        missing keys defaulted) — the migration path for external callers."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in vars(args).items() if k in known and v is not None}
+        return cls(**kwargs)
+
+    def replace(self, **kw) -> "ExtractionConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def as_config(obj) -> ExtractionConfig:
+    """Normalize user input (dataclass, Namespace, or dict) to a config."""
+    if isinstance(obj, ExtractionConfig):
+        return obj
+    if isinstance(obj, argparse.Namespace):
+        return ExtractionConfig.from_namespace(obj)
+    if isinstance(obj, dict):
+        return ExtractionConfig(**obj)
+    raise TypeError(f"cannot build ExtractionConfig from {type(obj)!r}")
+
+
+def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
+    """Cross-field validation, mirroring ref utils/utils.py:129-150."""
+    if os.path.relpath(cfg.output_path) == os.path.relpath(cfg.tmp_path):
+        raise AssertionError("The same path for out & tmp")
+    if cfg.on_extraction not in ("print", "save_numpy", "save_pickle"):
+        raise ValueError(f"unknown on_extraction: {cfg.on_extraction}")
+    if cfg.show_pred and cfg.device_ids:
+        # predictions interleave across workers; pin to one device
+        cfg = cfg.replace(device_ids=[cfg.device_ids[0]])
+    if cfg.feature_type == "i3d" and cfg.stack_size is not None and cfg.stack_size < 10:
+        raise AssertionError(
+            f"I3D does not support inputs shorter than 10 timestamps, got {cfg.stack_size}"
+        )
+    if cfg.feature_type not in FEATURE_TYPES:
+        raise ValueError(f"unknown feature_type: {cfg.feature_type}")
+    return cfg
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """The reference CLI surface (ref main.py:94-137), plus TPU knobs."""
+    p = argparse.ArgumentParser(description="Extract features (TPU-native)")
+    p.add_argument("--feature_type", required=True, choices=FEATURE_TYPES)
+    p.add_argument("--video_paths", nargs="+", help="space-separated paths to videos")
+    p.add_argument("--flow_paths", nargs="+", help="space-separated paths to video flow images")
+    p.add_argument("--file_with_video_paths", help=".txt file where each line is a path")
+    p.add_argument("--video_dir", type=str, help="dir of videos")
+    p.add_argument(
+        "--flow_dir", type=str,
+        help="dir of optical flow of videos: [flow_dir]/[video id]/[flow_(x/y)_000001.jpg]",
+    )
+    p.add_argument(
+        "--device_ids", type=int, nargs="+",
+        help="space-separated device ids (indices into jax.devices())",
+    )
+    p.add_argument("--cpu", action="store_true", help="use cpu only")
+    p.add_argument("--tmp_path", default="./tmp")
+    p.add_argument("--keep_tmp_files", action="store_true", default=False)
+    p.add_argument("--on_extraction", default="print",
+                   choices=["print", "save_numpy", "save_pickle"])
+    p.add_argument("--output_path", default="./output")
+    p.add_argument("--output_direct", action="store_true",
+                   help="save as <stem>.npy instead of <stem>_<key>.npy")
+    p.add_argument("--extraction_fps", type=float)
+    p.add_argument("--extract_method", type=str, help="e.g. fix_2 or uni_12")
+    p.add_argument("--stack_size", type=int)
+    p.add_argument("--step_size", type=int)
+    p.add_argument("--streams", nargs="+", choices=["flow", "rgb"])
+    p.add_argument("--flow_type", choices=["raft", "pwc", "flow"], default="pwc")
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--resize_to_larger_edge", dest="resize_to_smaller_edge",
+                   action="store_false", default=True)
+    p.add_argument("--side_size", type=int)
+    p.add_argument("--show_pred", action="store_true", default=False)
+    # TPU-native extras
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--weights_path", type=str, default=None)
+    p.add_argument("--decode_workers", type=int, default=2)
+    return p
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> ExtractionConfig:
+    args = build_arg_parser().parse_args(argv)
+    return sanity_check(ExtractionConfig.from_namespace(args))

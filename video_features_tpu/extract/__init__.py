@@ -1,0 +1,2 @@
+from video_features_tpu.extract.base import BaseExtractor  # noqa: F401
+from video_features_tpu.extract.registry import build_extractor  # noqa: F401

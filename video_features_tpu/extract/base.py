@@ -1,0 +1,109 @@
+"""Extractor runtime: the per-video loop every feature type shares.
+
+This is the framework contract layer (SURVEY.md §1 L4). The reference
+implements it as a ``torch.nn.Module`` per feature type with a uniform
+shape — path list in ``__init__``, model built inside ``forward`` per
+replica, per-video try/except, results routed to the output sink (e.g.
+ref models/resnet/extract_resnet.py:25-71, models/CLIP/extract_clip.py:69-87).
+
+The TPU-native equivalent: a plain class whose per-device state is a
+lazily-built, cached bundle of jit-compiled functions + device-resident
+params (``warmup``/``_build``); ``__call__(indices, device)`` runs the
+video loop with the same error isolation and sink routing; the
+``external_call`` mode returns feature dicts in-memory instead
+(ref models/CLIP/extract_clip.py:22,73-77).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+from tqdm import tqdm
+
+from video_features_tpu.config import as_config
+from video_features_tpu.io.paths import form_list_from_user_input, video_path_of
+from video_features_tpu.io.sink import action_on_extraction
+
+
+class BaseExtractor:
+    """Subclasses set ``feature_type`` and implement ``_build`` + ``extract``."""
+
+    feature_type: str = ""
+
+    def __init__(self, config, external_call: bool = False) -> None:
+        self.config = as_config(config)
+        self.external_call = external_call
+        self.path_list = form_list_from_user_input(self.config)
+        self.progress = tqdm(total=len(self.path_list))
+        self._device_state: Dict[Any, Any] = {}
+        self._build_lock = threading.Lock()
+
+    # --- per-device model state -------------------------------------------
+    def _build(self, device) -> Any:
+        """Build jitted fns + device-resident params for ``device``."""
+        raise NotImplementedError
+
+    def warmup(self, device) -> Any:
+        """Build (once) and cache this device's model state. Thread-safe."""
+        key = device
+        state = self._device_state.get(key)
+        if state is None:
+            with self._build_lock:
+                state = self._device_state.get(key)
+                if state is None:
+                    state = self._build(device)
+                    self._device_state[key] = state
+        return state
+
+    # --- the video loop ----------------------------------------------------
+    def _default_device(self):
+        from video_features_tpu.parallel.devices import resolve_devices
+
+        return resolve_devices(self.config)[0]
+
+    def __call__(
+        self,
+        indices: Optional[Sequence[int]] = None,
+        device=None,
+    ) -> Optional[List[Dict[str, np.ndarray]]]:
+        if indices is None:
+            indices = range(len(self.path_list))
+        if device is None:
+            device = self._default_device()
+        state = self.warmup(device)
+
+        results: List[Dict[str, np.ndarray]] = []
+        for idx in indices:
+            entry = self.path_list[int(idx)]
+            try:
+                feats_dict = self.extract(device, state, entry)
+                if self.external_call:
+                    results.append(feats_dict)
+                else:
+                    action_on_extraction(
+                        feats_dict,
+                        video_path_of(entry),
+                        self.config.output_path,
+                        self.config.on_extraction,
+                        self.config.output_direct,
+                    )
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - per-video isolation (ref extract_clip.py:78-84)
+                print(f"An error occurred extracting {video_path_of(entry)}:")
+                traceback.print_exc()
+                print("Continuing...")
+            self.progress.update()
+        if self.external_call:
+            return results
+        return None
+
+    # torch-API compatibility: the reference invokes extractors as modules
+    forward = __call__
+
+    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+        """Decode -> preprocess -> model -> {feature_type, fps, timestamps_ms}."""
+        raise NotImplementedError

@@ -1,0 +1,45 @@
+"""Feature-type -> extractor dispatch (ref main.py:15-41).
+
+Imports are lazy per feature type, mirroring the reference's
+import-inside-branch pattern — here it keeps startup light rather than
+dodging conda-env conflicts (the reference needed 3 incompatible envs;
+this framework needs one).
+"""
+
+from __future__ import annotations
+
+from video_features_tpu.config import CLIP_FEATURE_TYPES, RESNET_FEATURE_TYPES, as_config
+
+
+def build_extractor(config, external_call: bool = False):
+    cfg = as_config(config)
+    ft = cfg.feature_type
+    if ft in CLIP_FEATURE_TYPES:
+        from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+        return ExtractCLIP(cfg, external_call)
+    if ft in RESNET_FEATURE_TYPES:
+        from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+        return ExtractResNet(cfg, external_call)
+    if ft == "r21d_rgb":
+        from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+
+        return ExtractR21D(cfg, external_call)
+    if ft == "raft":
+        from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+
+        return ExtractRAFT(cfg, external_call)
+    if ft == "pwc":
+        from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+
+        return ExtractPWC(cfg, external_call)
+    if ft == "i3d":
+        from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+        return ExtractI3D(cfg, external_call)
+    if ft in ("vggish", "vggish_torch"):
+        from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
+
+        return ExtractVGGish(cfg, external_call)
+    raise ValueError(f"unknown feature_type: {ft}")

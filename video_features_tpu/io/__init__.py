@@ -1,0 +1,8 @@
+from video_features_tpu.io.paths import form_list_from_user_input, form_slices  # noqa: F401
+from video_features_tpu.io.sink import action_on_extraction  # noqa: F401
+from video_features_tpu.io.video import (  # noqa: F401
+    VideoMeta,
+    extract_frames,
+    read_all_frames,
+    stream_frames,
+)

@@ -1,0 +1,77 @@
+"""Audio input: wav reading + resampling on the host.
+
+The reference reads wav via soundfile, normalizes int16 by 32768, mixes to
+mono, and resamples to 16 kHz with resampy (ref
+models/vggish/vggish_src/vggish_input.py:74-87 and :57-60). Neither
+soundfile nor resampy is assumed here: wav decode uses scipy.io.wavfile
+and resampling uses a polyphase filter (scipy.signal.resample_poly), which
+is the same class of kaiser-windowed sinc resampler resampy implements.
+
+For videos, the wav is ripped via io.ffmpeg when an ffmpeg binary exists;
+``.wav`` inputs are consumed directly either way.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Tuple
+
+import numpy as np
+from scipy.io import wavfile
+from scipy.signal import resample_poly
+
+
+def read_wav(path: str) -> Tuple[np.ndarray, int]:
+    """-> (float32 samples in [-1, 1], shape (n,) or (n, ch); sample rate)."""
+    sr, data = wavfile.read(path)
+    if data.dtype == np.int16:
+        data = data / 32768.0
+    elif data.dtype == np.int32:
+        data = data / 2147483648.0
+    elif data.dtype == np.uint8:
+        data = (data.astype(np.float32) - 128.0) / 128.0
+    data = np.asarray(data, dtype=np.float32)
+    return data, int(sr)
+
+
+def to_mono(data: np.ndarray) -> np.ndarray:
+    return data.mean(axis=1) if data.ndim > 1 else data
+
+
+def resample(data: np.ndarray, src_sr: int, dst_sr: int) -> np.ndarray:
+    """Polyphase resampling src_sr -> dst_sr along axis 0."""
+    if src_sr == dst_sr:
+        return data
+    g = math.gcd(int(src_sr), int(dst_sr))
+    return resample_poly(data, dst_sr // g, src_sr // g, axis=0).astype(np.float32)
+
+
+def load_audio_for_model(
+    path: str,
+    target_sr: int,
+    tmp_path: str = "./tmp",
+    keep_tmp_files: bool = False,
+) -> np.ndarray:
+    """Full audio front door: wav/video path -> mono float32 at target_sr.
+
+    Video containers are ripped to wav via ffmpeg into ``tmp_path``; the
+    temp wav/aac are deleted afterwards unless ``keep_tmp_files`` (the
+    reference's --keep_tmp_files contract, ref main.py:108-109).
+    """
+    tmp_files = []
+    if not path.lower().endswith(".wav"):
+        from video_features_tpu.io.ffmpeg import extract_wav_from_video
+
+        path, aac = extract_wav_from_video(path, tmp_path)
+        tmp_files = [path, aac]
+    try:
+        data, sr = read_wav(path)
+    finally:
+        if not keep_tmp_files:
+            for f in tmp_files:
+                try:
+                    os.remove(f)
+                except OSError:
+                    pass
+    return resample(to_mono(data), sr, target_sr)

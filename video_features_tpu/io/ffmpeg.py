@@ -1,0 +1,58 @@
+"""Optional ffmpeg boundary (gated: the binary may be absent).
+
+The reference shells out to ffmpeg for fps re-encoding (ref
+utils/utils.py:222-244) and the mp4 -> aac -> wav audio rip (ref
+utils/utils.py:247-276). This framework does fps re-targeting in-process
+(io.video._resample_indices) and reads wav directly, so ffmpeg is only
+*required* for audio extraction from containers — and these helpers raise
+a clear error when the binary is missing instead of failing mid-pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+from typing import Tuple
+
+
+def which_ffmpeg() -> str:
+    """Path to ffmpeg, or '' when not installed (ref utils/utils.py:207-219)."""
+    return shutil.which("ffmpeg") or ""
+
+
+def require_ffmpeg() -> str:
+    path = which_ffmpeg()
+    if not path:
+        raise RuntimeError(
+            "ffmpeg binary not found. Audio extraction from video containers "
+            "requires ffmpeg; pass a .wav file directly instead, or install ffmpeg."
+        )
+    return path
+
+
+def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: float) -> str:
+    """Re-encode to target fps into tmp_path (ref utils/utils.py:222-244)."""
+    ffmpeg = require_ffmpeg()
+    os.makedirs(tmp_path, exist_ok=True)
+    new_path = os.path.join(tmp_path, f"{pathlib.Path(video_path).stem}_new_fps.mp4")
+    subprocess.call(
+        [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i", video_path,
+         "-filter:v", f"fps=fps={extraction_fps}", new_path]
+    )
+    return new_path
+
+
+def extract_wav_from_video(video_path: str, tmp_path: str) -> Tuple[str, str]:
+    """Container -> .aac -> .wav two-stage rip (ref utils/utils.py:247-276)."""
+    ffmpeg = require_ffmpeg()
+    os.makedirs(tmp_path, exist_ok=True)
+    stem = pathlib.Path(video_path).stem
+    aac_path = os.path.join(tmp_path, f"{stem}.aac")
+    wav_path = os.path.join(tmp_path, f"{stem}.wav")
+    subprocess.call([ffmpeg, "-hide_banner", "-loglevel", "panic", "-y",
+                     "-i", video_path, "-acodec", "copy", aac_path])
+    subprocess.call([ffmpeg, "-hide_banner", "-loglevel", "panic", "-y",
+                     "-i", aac_path, wav_path])
+    return wav_path, aac_path

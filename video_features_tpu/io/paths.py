@@ -1,0 +1,74 @@
+"""Path-list forming and sliding-window slice math.
+
+Behavior mirrors ref utils/utils.py:117-126 (form_slices) and :153-204
+(form_list_from_user_input): the video list IS the dataset; a path entry is
+either a video path or a ``(video_path, flow_dir_for_video)`` pair when
+pre-extracted flow is consumed.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+from typing import List, Tuple, Union
+
+PathEntry = Union[str, Tuple[str, str]]
+
+
+def form_slices(size: int, stack_size: int, step_size: int) -> List[Tuple[int, int]]:
+    """(start, end) index windows over ``size`` frames; drops the ragged tail,
+    exactly like ref utils/utils.py:117-126."""
+    slices = []
+    full_stack_num = (size - stack_size) // step_size + 1
+    for i in range(full_stack_num):
+        start = i * step_size
+        slices.append((start, start + stack_size))
+    return slices
+
+
+def form_list_from_user_input(cfg) -> List[PathEntry]:
+    """Resolve the user's input selection into a list of path entries.
+
+    Precedence and pairing rules follow ref utils/utils.py:153-204:
+    file-with-paths > video_dir (zipped with flow_dir by sorted stem) >
+    explicit video_paths (zipped with flow_paths by stem).
+    """
+    if cfg.file_with_video_paths is not None:
+        with open(cfg.file_with_video_paths) as rfile:
+            path_list: List[PathEntry] = [
+                line.strip() for line in rfile.readlines() if line.strip()
+            ]
+    elif cfg.video_dir is not None:
+        if cfg.flow_dir is None:
+            path_list = sorted(str(p) for p in pathlib.Path(cfg.video_dir).glob("*"))
+        else:
+            v_list = sorted(pathlib.Path(cfg.video_dir).glob("*"), key=lambda x: x.stem)
+            f_list = sorted(pathlib.Path(cfg.flow_dir).glob("*"), key=lambda x: x.stem)
+            path_list = [
+                (str(v), str(f))
+                for v, f in zip(v_list, f_list)
+                if v.stem == f.stem
+            ]
+    elif cfg.video_paths is not None:
+        if cfg.flow_paths is None:
+            path_list = list(cfg.video_paths)
+        else:
+            path_list = [
+                (v, f)
+                for v, f in zip(cfg.video_paths, cfg.flow_paths)
+                if pathlib.Path(v).stem == pathlib.Path(f).stem
+            ]
+    else:
+        raise ValueError("no video provided")
+
+    for entry in path_list:
+        paths = entry if isinstance(entry, tuple) else (entry,)
+        for p in paths:
+            if not os.path.exists(p):
+                raise ValueError(f"path does not exist: {p}")
+
+    return path_list
+
+
+def video_path_of(entry: PathEntry) -> str:
+    return entry[0] if isinstance(entry, (tuple, list)) else entry

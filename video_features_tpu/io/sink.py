@@ -1,0 +1,70 @@
+"""Output sink: what happens to an extracted feature dict.
+
+Mirrors ref utils/utils.py:50-114 (``action_on_extraction``): features are
+printed with max/mean/min stats, or saved as ``<stem>_<key>.npy`` /
+``<stem>_<key>.pkl`` (``<stem>.npy`` when ``output_direct``); meta keys
+``fps`` and ``timestamps_ms`` are never saved. The reference's vestigial
+``save_jpg`` flow branch (buggy at ref utils/utils.py:105 — iterating an
+int) is implemented correctly here for 2-channel flow features.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import pickle
+from typing import Dict, List, Union
+
+import numpy as np
+
+META_KEYS = ("fps", "timestamps_ms")
+
+
+def action_on_extraction(
+    feats_dict: Dict[str, np.ndarray],
+    video_path: Union[str, List[str]],
+    output_path: str,
+    on_extraction: str,
+    output_direct: bool = False,
+) -> None:
+    suffix = {"save_numpy": "npy", "save_pickle": "pkl"}
+    if isinstance(video_path, (list, tuple)):
+        video_path = video_path[0]
+    name = pathlib.Path(video_path).stem
+
+    for key, value in feats_dict.items():
+        if key in META_KEYS:
+            continue
+        value = np.asarray(value)
+        if on_extraction == "print":
+            print(key)
+            print(value)
+            print(f"max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}")
+            print()
+        elif on_extraction in ("save_numpy", "save_pickle"):
+            os.makedirs(output_path, exist_ok=True)
+            fname = f"{name}.{suffix[on_extraction]}" if output_direct \
+                else f"{name}_{key}.{suffix[on_extraction]}"
+            fpath = os.path.join(output_path, fname)
+            if len(value) == 0:
+                print(f"Warning: the value is empty for {key} @ {fpath}")
+            if on_extraction == "save_numpy":
+                np.save(fpath, value)
+            else:
+                with open(fpath, "wb") as f:
+                    pickle.dump(value, f)
+        elif on_extraction == "save_jpg":
+            # flow (T, 2, H, W) -> per-pair x/y grayscale jpgs
+            from PIL import Image
+
+            os.makedirs(output_path, exist_ok=True)
+            vdir = os.path.join(output_path, name)
+            os.makedirs(vdir, exist_ok=True)
+            for f_num in range(value.shape[0]):
+                for ch, axis in enumerate("xy"):
+                    img = Image.fromarray(value[f_num, ch].astype(np.uint8))
+                    img.convert("L").save(
+                        os.path.join(vdir, f"{f_num:0>5d}_{axis}.jpg")
+                    )
+        else:
+            raise NotImplementedError(f"on_extraction: {on_extraction} is not implemented")

@@ -1,0 +1,175 @@
+"""Video decode + frame sampling on the host CPU.
+
+The reference uses four decode backends (mmcv, cv2 streaming, torchvision
+read_video, ffmpeg re-encode — SURVEY.md §1 L3). Here there is ONE:
+OpenCV's ``cv2.VideoCapture``, wrapped in
+
+- :func:`stream_frames` — a generator for frame-wise extractors (the
+  cv2 streaming loop of ref models/resnet/extract_resnet.py:121-156),
+- :func:`read_all_frames` — whole-clip decode for stack-wise extractors
+  (ref models/r21d/extract_r21d.py:102, models/i3d/extract_i3d.py:239-259),
+- :func:`extract_frames` — the ``fix_N`` / ``uni_N`` samplers
+  (ref utils/utils.py:297-333).
+
+fps re-targeting is done in-process by nearest-timestamp frame selection
+instead of an ffmpeg re-encode subprocess (ref utils/utils.py:222-244);
+if an ffmpeg binary exists it can still be used via io.ffmpeg. Frames are
+returned RGB uint8 HWC (cv2 decodes BGR; we flip here, once — extractors
+needing BGR, i.e. PWC, flip back inside their preprocess).
+
+Note: the reference computes ``mspf = 0.001 / fps`` (ref
+utils/utils.py:312) which is a unit bug; the correct milliseconds-per-frame
+``1000 / fps`` is used here (matching upstream v-iashin/video_features).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Tuple
+
+import cv2
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class VideoMeta:
+    fps: float
+    frame_count: int
+    width: int
+    height: int
+
+    @property
+    def duration_s(self) -> float:
+        return self.frame_count / self.fps if self.fps else 0.0
+
+
+def probe(path: str) -> VideoMeta:
+    cap = cv2.VideoCapture(str(path))
+    if not cap.isOpened():
+        raise IOError(f"cannot open video: {path}")
+    meta = VideoMeta(
+        fps=cap.get(cv2.CAP_PROP_FPS),
+        frame_count=int(cap.get(cv2.CAP_PROP_FRAME_COUNT)),
+        width=int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)),
+        height=int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)),
+    )
+    cap.release()
+    return meta
+
+
+def stream_frames(
+    path: str,
+    extraction_fps: Optional[float] = None,
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """Yield (rgb_uint8_hwc, timestamp_ms) frames sequentially.
+
+    With ``extraction_fps`` set, frames are selected on the target fps grid
+    while still decoding sequentially (no random seeks — mp4 seeking in
+    cv2 is keyframe-inaccurate).
+    """
+    cap = cv2.VideoCapture(str(path))
+    if not cap.isOpened():
+        raise IOError(f"cannot open video: {path}")
+    src_fps = cap.get(cv2.CAP_PROP_FPS) or 25.0
+    frame_count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
+
+    try:
+        if extraction_fps is None:
+            i = 0
+            while True:
+                ok, frame = cap.read()
+                if not ok:
+                    break
+                yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB), i * 1000.0 / src_fps
+                i += 1
+        else:
+            # Select source frames nearest the target fps grid while decoding
+            # sequentially. Works without a (reliable) frame count: output
+            # frame k maps to source index round(k * src_fps / dst_fps);
+            # duplicates when upsampling, drops when downsampling.
+            out_k = 0
+            src_i = -1
+            frame = None
+            while True:
+                target = int(round(out_k * src_fps / extraction_fps))
+                while src_i < target:
+                    ok, nxt = cap.read()
+                    if not ok:
+                        return
+                    frame = nxt
+                    src_i += 1
+                yield (
+                    cv2.cvtColor(frame, cv2.COLOR_BGR2RGB),
+                    out_k * 1000.0 / extraction_fps,
+                )
+                out_k += 1
+    finally:
+        cap.release()
+
+
+def read_all_frames(
+    path: str,
+    extraction_fps: Optional[float] = None,
+) -> Tuple[List[np.ndarray], float, List[float]]:
+    """Whole-clip decode -> (rgb frames, effective fps, timestamps_ms)."""
+    meta = probe(path)
+    fps = extraction_fps or meta.fps or 25.0
+    frames, stamps = [], []
+    for frame, ts in stream_frames(path, extraction_fps):
+        frames.append(frame)
+        stamps.append(ts)
+    return frames, fps, stamps
+
+
+def extract_frames(
+    path: str,
+    method: str,
+) -> Tuple[List[np.ndarray], float, List[float]]:
+    """``fix_<fps>`` / ``uni_<N>`` samplers, mirroring ref utils/utils.py:297-333.
+
+    Both sample indices as ``linspace(1, frame_cnt - 2, n)`` ("ignore some
+    frames to avoid strange bugs" — i.e. skip first/last, which are
+    decode-fragile). Returns (rgb frames, source fps, timestamps_ms).
+    """
+    ext, *params = method.split("_")
+    meta = probe(path)
+    fps, frame_cnt = meta.fps or 25.0, meta.frame_count
+    if frame_cnt < 3:
+        raise IOError(f"video too short for sampling ({frame_cnt} frames): {path}")
+    mspf = 1000.0 / fps
+
+    if ext == "fix":
+        samples_num = int(frame_cnt / fps * int(params[0]))
+    elif ext == "uni":
+        samples_num = int(params[0])
+    else:
+        raise NotImplementedError(f"extract method {ext!r} is not supported")
+    samples_num = max(samples_num, 1)
+    samples_ix = np.linspace(1, frame_cnt - 2, samples_num).astype(int)
+
+    wanted = set(samples_ix.tolist())
+    got = {}
+    cap = cv2.VideoCapture(str(path))
+    try:
+        i = 0
+        last = max(wanted)
+        while i <= last:
+            ok, frame = cap.read()
+            if not ok:
+                break
+            if i in wanted:
+                got[i] = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+            i += 1
+    finally:
+        cap.release()
+    if not got:
+        raise IOError(f"no frames decoded from {path}")
+    # duplicate indices in linspace (short videos) resolve to the same frame
+    last_seen = None
+    frames = []
+    for ix in samples_ix:
+        if ix in got:
+            last_seen = got[ix]
+        frames.append(last_seen if last_seen is not None else next(iter(got.values())))
+    timestamps_ms = [float(ix) * mspf for ix in samples_ix]
+    return frames, fps, timestamps_ms

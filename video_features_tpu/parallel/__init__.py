@@ -1,0 +1,2 @@
+from video_features_tpu.parallel.devices import resolve_devices  # noqa: F401
+from video_features_tpu.parallel.scheduler import parallel_feature_extraction  # noqa: F401

@@ -1,0 +1,34 @@
+"""Device addressing: ``--device_ids`` transparently indexes ``jax.devices()``.
+
+The reference smuggles the device through a scattered index tensor's
+``.device`` attribute (ref main.py:43-53). Here devices are first-class
+``jax.Device`` objects: extractors place inputs with ``jax.device_put`` and
+jit-compile once per device (the XLA analog of the reference's build-the-
+model-inside-forward-per-replica pattern, ref
+models/resnet/extract_resnet.py:52-71).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def resolve_devices(cfg=None, *, cpu: Optional[bool] = None,
+                    device_ids: Optional[Sequence[int]] = None) -> List["jax.Device"]:
+    import jax
+
+    if cfg is not None:
+        cpu = cfg.cpu if cpu is None else cpu
+        device_ids = cfg.device_ids if device_ids is None else device_ids
+    if cpu:
+        return [jax.local_devices(backend="cpu")[0]]
+    devices = list(jax.devices())
+    if device_ids:
+        bad = [i for i in device_ids if i >= len(devices)]
+        if bad:
+            raise ValueError(
+                f"device_ids {bad} out of range: only {len(devices)} devices "
+                f"visible ({[str(d) for d in devices]})"
+            )
+        return [devices[i] for i in device_ids]
+    return devices

@@ -1,0 +1,82 @@
+"""Video-level data parallelism: a dynamic work queue over devices.
+
+The reference's only parallelism strategy is a static even split of the
+video list across GPU threads via ``replicate``/``scatter``/
+``parallel_apply`` (ref main.py:49-55). The TPU-native redesign keeps the
+"video list is the dataset" contract but replaces the static split with a
+shared work queue drained by one host thread per device: decode (the usual
+bottleneck) load-balances across chips instead of leaving chips idle
+behind a long shard, and a dead worker's remaining items are picked up by
+the others instead of being silently lost (the reference failure mode
+noted in SURVEY.md §5).
+
+Threads, not processes: cv2 decode and XLA dispatch both release the GIL,
+and each device runs its own jit-compiled executable.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import List, Optional, Sequence
+
+
+def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -> None:
+    """Extract features for every video in ``extractor.path_list``.
+
+    Each device thread repeatedly pulls one video index and runs the
+    extractor on it; per-video error isolation lives inside the extractor
+    (ref models/CLIP/extract_clip.py:69-87).
+    """
+    from video_features_tpu.parallel.devices import resolve_devices
+
+    if devices is None:
+        devices = resolve_devices(extractor.config)
+
+    n = len(extractor.path_list)
+    work: "queue.Queue[int]" = queue.Queue()
+    for idx in range(n):
+        work.put(idx)
+
+    errors: List[BaseException] = []
+
+    def worker(device) -> None:
+        # Build (and compile) this device's model once, up front.
+        try:
+            extractor.warmup(device)
+        except Exception as e:  # noqa: BLE001 - surface below
+            errors.append(e)
+            traceback.print_exc()
+            return
+        while True:
+            try:
+                idx = work.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                extractor([idx], device=device)
+            except KeyboardInterrupt:
+                errors.append(KeyboardInterrupt())
+                return
+            finally:
+                work.task_done()
+
+    if len(devices) == 1:
+        worker(devices[0])
+    else:
+        threads = [
+            threading.Thread(target=worker, args=(d,), daemon=True, name=f"extract-{d}")
+            for d in devices
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    extractor.progress.close()
+    if errors and all(isinstance(e, KeyboardInterrupt) for e in errors):
+        raise KeyboardInterrupt
+    if len(errors) == len(devices) and devices:
+        # every worker died before draining the queue -> nothing ran; raise
+        raise RuntimeError(f"all {len(devices)} extraction workers failed") from errors[0]

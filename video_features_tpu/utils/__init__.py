@@ -1,0 +1,1 @@
+from video_features_tpu.utils.labels import load_classes, show_predictions_on_dataset  # noqa: F401
