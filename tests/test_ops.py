@@ -1,0 +1,168 @@
+"""Op-level parity vs torch oracles (torch CPU is in the env; SURVEY.md §4a)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from video_features_tpu.ops.correlation import all_pairs_correlation, local_correlation
+from video_features_tpu.ops.padding import InputPadder, same_padding_3d, tf_same_pads
+from video_features_tpu.ops.preprocess import (
+    flow_to_uint8,
+    imagenet_preprocess,
+    pil_center_crop,
+    pil_resize,
+    scale_to_1_1,
+    tensor_center_crop,
+)
+from video_features_tpu.ops.resize import resize_bilinear
+from video_features_tpu.ops.sampler import bilinear_sampler, grid_sample
+
+RNG = np.random.RandomState(42)
+
+
+# --- grid_sample ----------------------------------------------------------
+
+@pytest.mark.parametrize("align_corners", [True, False])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border"])
+def test_grid_sample_matches_torch(align_corners, padding_mode):
+    img = RNG.randn(2, 3, 11, 17).astype(np.float32)
+    # grid partly out of range to exercise padding
+    grid = (RNG.rand(2, 5, 7, 2).astype(np.float32) * 2.6 - 1.3)
+    ref = F.grid_sample(
+        torch.from_numpy(img), torch.from_numpy(grid),
+        mode="bilinear", padding_mode=padding_mode, align_corners=align_corners,
+    ).numpy()
+    out = np.asarray(grid_sample(jnp.asarray(img), jnp.asarray(grid),
+                                 padding_mode=padding_mode, align_corners=align_corners))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_bilinear_sampler_matches_raft_semantics():
+    # pixel-coordinate sampling == normalize + align_corners=True grid_sample
+    img = RNG.randn(1, 4, 9, 13).astype(np.float32)
+    coords = RNG.rand(1, 6, 6, 2).astype(np.float32) * 14 - 1
+    H, W = 9, 13
+    xg = 2 * coords[..., 0] / (W - 1) - 1
+    yg = 2 * coords[..., 1] / (H - 1) - 1
+    ref = F.grid_sample(
+        torch.from_numpy(img),
+        torch.from_numpy(np.stack([xg, yg], -1)),
+        align_corners=True,
+    ).numpy()
+    out = np.asarray(bilinear_sampler(jnp.asarray(img), jnp.asarray(coords)))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    out2, mask = bilinear_sampler(jnp.asarray(img), jnp.asarray(coords), mask=True)
+    assert mask.shape == (1, 6, 6)
+
+
+# --- resize ---------------------------------------------------------------
+
+@pytest.mark.parametrize("align_corners", [True, False])
+@pytest.mark.parametrize("size", [(20, 30), (5, 7), (12, 16)])
+def test_resize_bilinear_matches_torch_interpolate(align_corners, size):
+    x = RNG.randn(2, 3, 12, 16).astype(np.float32)
+    ref = F.interpolate(torch.from_numpy(x), size=size, mode="bilinear",
+                        align_corners=align_corners).numpy()
+    out = np.asarray(resize_bilinear(jnp.asarray(x), size, align_corners=align_corners))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# --- correlation ----------------------------------------------------------
+
+def test_all_pairs_correlation():
+    f1 = RNG.randn(2, 8, 5, 6).astype(np.float32)
+    f2 = RNG.randn(2, 8, 5, 6).astype(np.float32)
+    ref = np.einsum("nchw,ncij->nhwij", f1, f2) / np.sqrt(8)
+    out = np.asarray(all_pairs_correlation(jnp.asarray(f1), jnp.asarray(f2)))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_local_correlation_matches_naive():
+    N, C, H, W, d = 2, 6, 7, 9, 4
+    f1 = RNG.randn(N, C, H, W).astype(np.float32)
+    f2 = RNG.randn(N, C, H, W).astype(np.float32)
+    ref = np.zeros((N, (2 * d + 1) ** 2, H, W), np.float32)
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            tc = (dy + d) * (2 * d + 1) + (dx + d)
+            for y in range(H):
+                for x in range(W):
+                    y2, x2 = y + dy, x + dx
+                    if 0 <= y2 < H and 0 <= x2 < W:
+                        ref[:, tc, y, x] = (f1[:, :, y, x] * f2[:, :, y2, x2]).mean(-1)
+    out = np.asarray(local_correlation(jnp.asarray(f1), jnp.asarray(f2), d))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# --- padding --------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", [(1, 3, 436, 1024), (1, 3, 241, 321), (1, 3, 240, 320)])
+def test_input_padder_matches_torch_replicate(shape):
+    x = RNG.randn(*shape).astype(np.float32)
+    padder = InputPadder(shape)
+    (out,) = padder.pad(jnp.asarray(x))
+    assert out.shape[-2] % 8 == 0 and out.shape[-1] % 8 == 0
+    # torch oracle with same pad amounts
+    ref = F.pad(torch.from_numpy(x), padder._pad, mode="replicate").numpy()
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    np.testing.assert_array_equal(np.asarray(padder.unpad(out)), x)
+
+
+def test_tf_same_pads_matches_tf_formula():
+    # TF SAME: out = ceil(in/stride); pad_total = max((out-1)*s + k - in, 0)
+    for size in (7, 8, 9, 16, 17, 64):
+        for k in (1, 2, 3, 7):
+            for s in (1, 2, 3):
+                out = -(-size // s)
+                total = max((out - 1) * s + k - size, 0)
+                before, after = tf_same_pads(size, k, s)
+                assert before + after == total
+                assert after - before in (0, 1)  # extra cell goes after
+    pads = same_padding_3d((16, 17, 9), (3, 3, 3), (2, 2, 2))
+    assert pads == [(0, 1), (1, 1), (1, 1)]
+
+
+# --- preprocess -----------------------------------------------------------
+
+def test_pil_resize_min_and_max_edge():
+    img = RNG.randint(0, 255, (120, 200, 3)).astype(np.uint8)
+    out = pil_resize(img, 60)  # smaller edge (h) -> 60
+    assert out.shape == (60, 100, 3)
+    out = pil_resize(img, 100, resize_to_smaller_edge=False)  # larger edge -> 100
+    assert out.shape == (60, 100, 3)
+    out = pil_resize(img, (50, 70))
+    assert out.shape == (50, 70, 3)
+
+
+def test_center_crop_matches_torchvision_rounding():
+    img = np.arange(7 * 9 * 3, dtype=np.uint8).reshape(7, 9, 3)
+    out = pil_center_crop(img, 4)
+    # torchvision crops at round((h-c)/2)=2, round((w-c)/2)=2 (banker's: 2.5->2)
+    np.testing.assert_array_equal(out, img[2:6, 2:6])
+    small = pil_center_crop(np.ones((2, 2, 3), np.uint8), 4)
+    assert small.shape == (4, 4, 3)
+
+
+def test_imagenet_preprocess_shape_and_range():
+    img = RNG.randint(0, 255, (240, 320, 3)).astype(np.uint8)
+    out = imagenet_preprocess(img)
+    assert out.shape == (3, 224, 224)
+    assert out.dtype == np.float32
+    assert -3.0 < out.mean() < 3.0
+
+
+def test_tensor_transforms():
+    x = jnp.arange(2 * 8 * 8, dtype=jnp.float32).reshape(2, 8, 8)
+    assert tensor_center_crop(x, 4).shape == (2, 4, 4)
+    np.testing.assert_allclose(
+        np.asarray(scale_to_1_1(jnp.array([0.0, 255.0]))), [-1, 1], atol=1e-6
+    )
+    # flow quantization: -20 -> 0? no: 128 - 127.5 = 0.5 -> round 0 (banker's)
+    q = np.asarray(flow_to_uint8(jnp.array([-30.0, 0.0, 30.0])))
+    ref = torch.tensor([-30.0, 0.0, 30.0]).clamp(-20, 20)
+    ref = (128 + 255 / 40 * ref).round().numpy()
+    np.testing.assert_array_equal(q, ref)

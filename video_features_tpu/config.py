@@ -123,9 +123,9 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise AssertionError("The same path for out & tmp")
     if cfg.on_extraction not in ("print", "save_numpy", "save_pickle"):
         raise ValueError(f"unknown on_extraction: {cfg.on_extraction}")
-    if cfg.show_pred and cfg.device_ids:
+    if cfg.show_pred:
         # predictions interleave across workers; pin to one device
-        cfg = cfg.replace(device_ids=[cfg.device_ids[0]])
+        cfg = cfg.replace(device_ids=[cfg.device_ids[0]] if cfg.device_ids else [0])
     if cfg.feature_type == "i3d" and cfg.stack_size is not None and cfg.stack_size < 10:
         raise AssertionError(
             f"I3D does not support inputs shorter than 10 timestamps, got {cfg.stack_size}"

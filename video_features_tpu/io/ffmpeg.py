@@ -37,11 +37,17 @@ def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps:
     ffmpeg = require_ffmpeg()
     os.makedirs(tmp_path, exist_ok=True)
     new_path = os.path.join(tmp_path, f"{pathlib.Path(video_path).stem}_new_fps.mp4")
-    subprocess.call(
-        [ffmpeg, "-hide_banner", "-loglevel", "panic", "-y", "-i", video_path,
-         "-filter:v", f"fps=fps={extraction_fps}", new_path]
-    )
+    _run([ffmpeg, "-hide_banner", "-loglevel", "error", "-y", "-i", video_path,
+          "-filter:v", f"fps=fps={extraction_fps}", new_path])
     return new_path
+
+
+def _run(cmd) -> None:
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"ffmpeg failed (exit {proc.returncode}): {' '.join(cmd)}\n{proc.stderr.strip()}"
+        )
 
 
 def extract_wav_from_video(video_path: str, tmp_path: str) -> Tuple[str, str]:
@@ -51,8 +57,8 @@ def extract_wav_from_video(video_path: str, tmp_path: str) -> Tuple[str, str]:
     stem = pathlib.Path(video_path).stem
     aac_path = os.path.join(tmp_path, f"{stem}.aac")
     wav_path = os.path.join(tmp_path, f"{stem}.wav")
-    subprocess.call([ffmpeg, "-hide_banner", "-loglevel", "panic", "-y",
-                     "-i", video_path, "-acodec", "copy", aac_path])
-    subprocess.call([ffmpeg, "-hide_banner", "-loglevel", "panic", "-y",
-                     "-i", aac_path, wav_path])
+    _run([ffmpeg, "-hide_banner", "-loglevel", "error", "-y",
+          "-i", video_path, "-acodec", "copy", aac_path])
+    _run([ffmpeg, "-hide_banner", "-loglevel", "error", "-y",
+          "-i", aac_path, wav_path])
     return wav_path, aac_path

@@ -1,0 +1,4 @@
+from video_features_tpu.ops.correlation import all_pairs_correlation, local_correlation  # noqa: F401
+from video_features_tpu.ops.padding import InputPadder, same_padding_3d  # noqa: F401
+from video_features_tpu.ops.resize import resize_bilinear  # noqa: F401
+from video_features_tpu.ops.sampler import bilinear_sampler, grid_sample  # noqa: F401

@@ -1,0 +1,59 @@
+"""Cost-volume correlation ops — the reference's only custom CUDA territory.
+
+Two flavors:
+
+- :func:`all_pairs_correlation` — RAFT's global (H·W)² correlation,
+  a single big matmul (ref raft_src/corr.py:19-27). On TPU this IS the
+  idiomatic form: one MXU einsum, no custom kernel needed.
+- :func:`local_correlation` — PWC's 81-channel (9×9 displacement) cost
+  volume, the op the reference implements as four embedded CUDA-C kernels
+  JIT-compiled via CuPy (ref pwc_src/correlation.py:17-242). Semantics
+  (from kernel_Correlation_updateOutput, ref :44-112): channel
+  ``tc = (dy+4)*9 + (dx+4)`` holds ``mean_c f1[c,y,x] * f2[c,y+dy,x+dx]``
+  with zero padding outside f2. Here it is expressed as 81 shifted
+  multiply-reduces XLA fuses on the VPU; a Pallas VMEM-tiled kernel
+  (ops/pallas/correlation_kernel.py) is the native equivalent for the
+  hot path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_pairs_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray) -> jnp.ndarray:
+    """RAFT global correlation: (N, C, H, W) x2 -> (N, H, W, H, W) / sqrt(C).
+
+    Full fp32 MXU precision: the correlation volume feeds 20 GRU refinement
+    iterations, so reduced-precision matmul drift compounds (the ≤1e-3 L2
+    parity budget of BASELINE.md).
+    """
+    N, C, H, W = fmap1.shape
+    corr = jnp.einsum(
+        "nchw,ncij->nhwij", fmap1, fmap2, precision=jax.lax.Precision.HIGHEST
+    )
+    return corr / jnp.sqrt(jnp.array(C, fmap1.dtype))
+
+
+def local_correlation(
+    fmap1: jnp.ndarray,
+    fmap2: jnp.ndarray,
+    max_displacement: int = 4,
+) -> jnp.ndarray:
+    """PWC local correlation: (N, C, H, W) x2 -> (N, (2d+1)^2, H, W).
+
+    Output channel ``(dy+d)*(2d+1) + (dx+d)`` = mean over C of
+    ``f1[y, x] * f2[y+dy, x+dx]``, zero-padded — matching the reference
+    CUDA kernel including its 1/C normalization (ref
+    pwc_src/correlation.py:106-108).
+    """
+    N, C, H, W = fmap1.shape
+    d = max_displacement
+    f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (d, d), (d, d)))
+    planes = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            shifted = f2p[:, :, d + dy : d + dy + H, d + dx : d + dx + W]
+            planes.append(jnp.mean(fmap1 * shifted, axis=1))
+    return jnp.stack(planes, axis=1)

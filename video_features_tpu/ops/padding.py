@@ -1,0 +1,63 @@
+"""Padding utilities: RAFT's /8 InputPadder and TF-style asymmetric SAME.
+
+``InputPadder`` mirrors ref models/raft/raft_src/utils/utils.py:7-24
+(replicate-pad H and W up to multiples of 8, split half-and-half in
+'sintel' mode). ``same_padding_3d`` reproduces the TF SAME convention the
+I3D port needs — when total padding is odd TF puts the extra cell on the
+*end* (bottom/right), which torch convs can't express and the reference
+emulates with explicit ConstantPad3d (ref
+models/i3d/i3d_src/i3d_net.py:8-25,108-120).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+class InputPadder:
+    """Pads NCHW images so H and W are divisible by ``factor``."""
+
+    def __init__(self, dims: Sequence[int], mode: str = "sintel", factor: int = 8):
+        self.ht, self.wd = dims[-2:]
+        pad_ht = (((self.ht // factor) + 1) * factor - self.ht) % factor
+        pad_wd = (((self.wd // factor) + 1) * factor - self.wd) % factor
+        if mode == "sintel":
+            # (left, right, top, bottom)
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, pad_ht // 2, pad_ht - pad_ht // 2]
+        else:
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
+
+    def pad(self, *inputs: jnp.ndarray) -> List[jnp.ndarray]:
+        l, r, t, b = self._pad
+        cfg = [(0, 0)] * (inputs[0].ndim - 2) + [(t, b), (l, r)]
+        return [jnp.pad(x, cfg, mode="edge") for x in inputs]
+
+    def unpad(self, x: jnp.ndarray) -> jnp.ndarray:
+        ht, wd = x.shape[-2:]
+        l, r, t, b = self._pad
+        return x[..., t : ht - b, l : wd - r]
+
+
+def tf_same_pads(size: int, kernel: int, stride: int) -> Tuple[int, int]:
+    """(before, after) padding for one dim under TF SAME semantics."""
+    if size % stride == 0:
+        total = max(kernel - stride, 0)
+    else:
+        total = max(kernel - (size % stride), 0)
+    return total // 2, total - total // 2
+
+
+def same_padding_3d(
+    shape_tdhw: Sequence[int],
+    kernel: Sequence[int],
+    stride: Sequence[int],
+) -> List[Tuple[int, int]]:
+    """Per-dim (before, after) pads for (T, H, W) under TF SAME. The 'after'
+    side gets the extra cell when total padding is odd — the asymmetry the
+    reference reproduces with ConstantPad3d (ref i3d_net.py:8-25)."""
+    return [
+        tf_same_pads(s, k, st)
+        for s, k, st in zip(shape_tdhw, kernel, stride)
+    ]

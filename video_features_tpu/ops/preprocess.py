@@ -1,0 +1,121 @@
+"""Shared preprocessing: host-side PIL/numpy image prep + device-side
+tensor transforms.
+
+Host side reproduces the reference's PIL-based chains byte-for-byte
+(torchvision's Resize/CenterCrop both bottom out in PIL —
+ref models/resnet/extract_resnet.py:33-38, and the improved min/max-edge
+resize of ref models/i3d/transforms/transforms.py:87-137). Device side
+carries the tensor-space transforms: center crop, [-1,1] scaling, flow
+clamp→uint8 quantization (ref i3d/transforms/transforms.py:7-51).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+import jax.numpy as jnp
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+KINETICS_MEAN = (0.43216, 0.394666, 0.37645)
+KINETICS_STD = (0.22803, 0.22145, 0.216989)
+
+
+# --- host side (PIL / numpy) ----------------------------------------------
+
+def pil_resize(
+    img: np.ndarray,
+    size,
+    resize_to_smaller_edge: bool = True,
+    interpolation=Image.BILINEAR,
+) -> np.ndarray:
+    """torchvision-style resize of an RGB uint8 HWC array via PIL.
+
+    int size -> matched to the smaller (or larger) edge, keeping aspect
+    (ref i3d/transforms/transforms.py:87-129); (h, w) -> exact.
+    """
+    pim = Image.fromarray(img)
+    if isinstance(size, int):
+        w, h = pim.size
+        if (w <= h and w == size) or (h <= w and h == size):
+            return img
+        if (w < h) == resize_to_smaller_edge:
+            ow, oh = size, int(size * h / w)
+        else:
+            oh, ow = size, int(size * w / h)
+        pim = pim.resize((ow, oh), interpolation)
+    else:
+        h, w = size
+        pim = pim.resize((w, h), interpolation)
+    return np.asarray(pim)
+
+
+def pil_center_crop(img: np.ndarray, crop: int) -> np.ndarray:
+    """torchvision CenterCrop on HWC (pads with zeros if smaller)."""
+    h, w = img.shape[:2]
+    if h < crop or w < crop:
+        pt = max((crop - h) // 2, 0)
+        pl = max((crop - w) // 2, 0)
+        img = np.pad(
+            img,
+            ((pt, max(crop - h - pt, 0)), (pl, max(crop - w - pl, 0)), (0, 0)),
+        )
+        h, w = img.shape[:2]
+    top = int(round((h - crop) / 2.0))
+    left = int(round((w - crop) / 2.0))
+    return img[top : top + crop, left : left + crop]
+
+
+def to_float_chw(img: np.ndarray) -> np.ndarray:
+    """HWC uint8 -> CHW float32 in [0, 1] (torchvision ToTensor)."""
+    return np.transpose(img, (2, 0, 1)).astype(np.float32) / 255.0
+
+
+def normalize_chw(
+    img: np.ndarray, mean: Sequence[float], std: Sequence[float]
+) -> np.ndarray:
+    mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def imagenet_preprocess(
+    img: np.ndarray,
+    resize_size: int = 256,
+    crop_size: int = 224,
+    mean: Sequence[float] = IMAGENET_MEAN,
+    std: Sequence[float] = IMAGENET_STD,
+    interpolation=Image.BILINEAR,
+) -> np.ndarray:
+    """The full Resize->CenterCrop->ToTensor->Normalize chain
+    (ref extract_resnet.py:33-38) -> CHW float32."""
+    img = pil_resize(img, resize_size, interpolation=interpolation)
+    img = pil_center_crop(img, crop_size)
+    return normalize_chw(to_float_chw(img), mean, std)
+
+
+# --- device side (jnp) ----------------------------------------------------
+
+def tensor_center_crop(x: jnp.ndarray, crop: int) -> jnp.ndarray:
+    """Center crop on the trailing (H, W) axes (ref transforms.py:7-18)."""
+    H, W = x.shape[-2], x.shape[-1]
+    fh = (H - crop) // 2
+    fw = (W - crop) // 2
+    return x[..., fh : fh + crop, fw : fw + crop]
+
+
+def scale_to_1_1(x: jnp.ndarray) -> jnp.ndarray:
+    """[0, 255] -> [-1, 1] (ref transforms.py:21-24)."""
+    return 2.0 * x / 255.0 - 1.0
+
+
+def flow_to_uint8(flow: jnp.ndarray, bound: float = 20.0) -> jnp.ndarray:
+    """Clamp flow to [-bound, bound] and quantize to the uint8 grid kept as
+    float — the Clamp -> ToUInt8 chain (ref transforms.py:33-51)."""
+    clamped = jnp.clip(flow, -bound, bound)
+    return jnp.round(128.0 + 255.0 / (2 * bound) * clamped)

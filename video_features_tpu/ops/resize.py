@@ -1,0 +1,52 @@
+"""Device-side bilinear resize with torch ``F.interpolate`` semantics.
+
+Needed because the flow nets bake resizes into their forward passes with
+*both* corner conventions: RAFT's ``upflow8`` uses ``align_corners=True``
+(ref raft_src/utils/utils.py:89-91); PWC resizes inputs to /64 multiples
+and upsamples flow with the default ``align_corners=False`` (ref
+pwc_src/pwc_net.py:241-261). ``jax.image.resize('linear')`` only matches
+the half-pixel (False) convention, so both are implemented here on the
+shared gather machinery.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _source_coords(out_size: int, in_size: int, align_corners: bool) -> jnp.ndarray:
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        if out_size == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return i * (in_size - 1) / (out_size - 1)
+    scale = in_size / out_size
+    return jnp.clip((i + 0.5) * scale - 0.5, 0.0, float(in_size - 1))
+
+
+def _lerp_axis(x: jnp.ndarray, out_size: int, axis: int, align_corners: bool) -> jnp.ndarray:
+    in_size = x.shape[axis]
+    if in_size == out_size:
+        return x
+    src = _source_coords(out_size, in_size, align_corners)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_size - 1)
+    w = (src - lo).astype(x.dtype)
+    xl = jnp.take(x, lo, axis=axis)
+    xh = jnp.take(x, hi, axis=axis)
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    w = w.reshape(shape)
+    return xl * (1 - w) + xh * w
+
+
+def resize_bilinear(
+    x: jnp.ndarray,
+    size,
+    align_corners: bool = False,
+) -> jnp.ndarray:
+    """Resize the last two axes of ``x`` (..., H, W) to ``size`` = (H', W')."""
+    H, W = size
+    x = _lerp_axis(x, H, x.ndim - 2, align_corners)
+    x = _lerp_axis(x, W, x.ndim - 1, align_corners)
+    return x

@@ -24,7 +24,7 @@ def resolve_devices(cfg=None, *, cpu: Optional[bool] = None,
         return [jax.local_devices(backend="cpu")[0]]
     devices = list(jax.devices())
     if device_ids:
-        bad = [i for i in device_ids if i >= len(devices)]
+        bad = [i for i in device_ids if i < 0 or i >= len(devices)]
         if bad:
             raise ValueError(
                 f"device_ids {bad} out of range: only {len(devices)} devices "
