@@ -8,12 +8,23 @@ bench.py.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# transformers (torch oracles) must not import tensorflow into this process
+os.environ.setdefault("USE_TF", "0")
+# 8 virtual CPU devices; must land before the cpu backend is created
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# jax may ALREADY be imported here: on TPU hosts a sitecustomize imports it
+# at interpreter startup, capturing JAX_PLATFORMS from the environment. Env
+# edits are therefore no-ops — pin the platform through the config API so
+# the unit suite never initializes the TPU backend (whose plugin dials a
+# network relay) regardless of import order.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -16,6 +16,7 @@ video loop with the same error isolation and sink routing; the
 
 from __future__ import annotations
 
+import os
 import threading
 import traceback
 from typing import Any, Dict, List, Optional, Sequence
@@ -36,8 +37,17 @@ class BaseExtractor:
     def __init__(self, config, external_call: bool = False) -> None:
         self.config = as_config(config)
         self.external_call = external_call
+        if not self.feature_type:
+            self.feature_type = self.config.feature_type
         self.path_list = form_list_from_user_input(self.config)
         self.progress = tqdm(total=len(self.path_list))
+        # features land in <output_path>/<feature_type>/ unless output_direct
+        # (ref models/CLIP/extract_clip.py:30-35)
+        if self.config.output_direct:
+            self.output_path = self.config.output_path
+        else:
+            self.output_path = os.path.join(self.config.output_path, self.feature_type)
+        self.tmp_path = os.path.join(self.config.tmp_path, self.feature_type)
         self._device_state: Dict[Any, Any] = {}
         self._build_lock = threading.Lock()
 
@@ -86,7 +96,7 @@ class BaseExtractor:
                     action_on_extraction(
                         feats_dict,
                         video_path_of(entry),
-                        self.config.output_path,
+                        self.output_path,
                         self.config.on_extraction,
                         self.config.output_direct,
                     )

@@ -42,10 +42,13 @@ def action_on_extraction(
             print(f"max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}")
             print()
         elif on_extraction in ("save_numpy", "save_pickle"):
-            os.makedirs(output_path, exist_ok=True)
             fname = f"{name}.{suffix[on_extraction]}" if output_direct \
                 else f"{name}_{key}.{suffix[on_extraction]}"
             fpath = os.path.join(output_path, fname)
+            # feature types may contain '/' (CLIP-ViT-B/32) which nests the
+            # path; create the full leaf dir (the reference's np.save would
+            # crash here — ref utils/utils.py:81-93 only makes output_path)
+            os.makedirs(os.path.dirname(fpath), exist_ok=True)
             if len(value) == 0:
                 print(f"Warning: the value is empty for {key} @ {fpath}")
             if on_extraction == "save_numpy":

@@ -1,0 +1,143 @@
+"""Checkpoint converters into the Flax CLIP visual tower.
+
+Two source formats:
+
+- OpenAI ``clip`` checkpoints — what the reference loads via ``clip.load``
+  (ref models/CLIP/extract_clip.py:46-63), including CLIP4CLIP fine-tunes
+  saved in the same naming (``visual.transformer.resblocks.*``; fused
+  ``attn.in_proj_weight``). Text-tower tensors are ignored: the reference
+  only ever calls ``encode_image``.
+- HuggingFace ``CLIPVisionModelWithProjection`` state dicts
+  (``vision_model.encoder.layers.*`` with split q/k/v) — the practical
+  offline weight source, and the torch oracle used by the parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    check_all_consumed,
+    conv2d_kernel,
+    transpose_linear,
+)
+
+
+def _ln(sd, name):
+    return {"scale": sd[f"{name}.weight"], "bias": sd[f"{name}.bias"]}
+
+
+def _dense(sd, name):
+    return {"kernel": transpose_linear(sd[f"{name}.weight"]), "bias": sd[f"{name}.bias"]}
+
+
+def from_openai(sd: Dict[str, np.ndarray], layers: int = 12) -> Dict:
+    """OpenAI clip state dict (full model or visual-only) -> flax params."""
+    v = {k: np.asarray(val, np.float32) for k, val in sd.items() if k.startswith("visual.")}
+    if not v:
+        raise ValueError("no 'visual.*' tensors found — not an OpenAI CLIP checkpoint?")
+    consumed = set()
+
+    def take(key):
+        consumed.add(f"visual.{key}")
+        return v[f"visual.{key}"]
+
+    params = {
+        "class_embedding": take("class_embedding"),
+        "positional_embedding": take("positional_embedding"),
+        "proj": take("proj"),
+        "conv1": {"kernel": conv2d_kernel(take("conv1.weight"))},
+        "ln_pre": {"scale": take("ln_pre.weight"), "bias": take("ln_pre.bias")},
+        "ln_post": {"scale": take("ln_post.weight"), "bias": take("ln_post.bias")},
+    }
+    for i in range(layers):
+        p = f"transformer.resblocks.{i}"
+        in_w = take(f"{p}.attn.in_proj_weight")  # (3D, D)
+        in_b = take(f"{p}.attn.in_proj_bias")
+        D = in_w.shape[1]
+        qw, kw, vw = in_w[:D], in_w[D : 2 * D], in_w[2 * D :]
+        qb, kb, vb = in_b[:D], in_b[D : 2 * D], in_b[2 * D :]
+        params[f"resblock_{i}"] = {
+            "ln_1": {"scale": take(f"{p}.ln_1.weight"), "bias": take(f"{p}.ln_1.bias")},
+            "ln_2": {"scale": take(f"{p}.ln_2.weight"), "bias": take(f"{p}.ln_2.bias")},
+            "attn": {
+                "q_proj": {"kernel": transpose_linear(qw), "bias": qb},
+                "k_proj": {"kernel": transpose_linear(kw), "bias": kb},
+                "v_proj": {"kernel": transpose_linear(vw), "bias": vb},
+                "out_proj": {
+                    "kernel": transpose_linear(take(f"{p}.attn.out_proj.weight")),
+                    "bias": take(f"{p}.attn.out_proj.bias"),
+                },
+            },
+            "c_fc": {
+                "kernel": transpose_linear(take(f"{p}.mlp.c_fc.weight")),
+                "bias": take(f"{p}.mlp.c_fc.bias"),
+            },
+            "c_proj": {
+                "kernel": transpose_linear(take(f"{p}.mlp.c_proj.weight")),
+                "bias": take(f"{p}.mlp.c_proj.bias"),
+            },
+        }
+    check_all_consumed(v, consumed, "CLIP-visual(openai)")
+    return params
+
+
+def from_hf_vision(sd: Dict[str, np.ndarray], layers: int = 12) -> Dict:
+    """HF CLIPVisionModelWithProjection state dict -> flax params."""
+    sd = {k: np.asarray(val, np.float32) for k, val in sd.items()}
+    consumed = set()
+
+    def take(key):
+        consumed.add(key)
+        return sd[key]
+
+    emb = "vision_model.embeddings"
+    params = {
+        "class_embedding": take(f"{emb}.class_embedding"),
+        "positional_embedding": take(f"{emb}.position_embedding.weight"),
+        "proj": transpose_linear(take("visual_projection.weight")),
+        "conv1": {"kernel": conv2d_kernel(take(f"{emb}.patch_embedding.weight"))},
+        # yes, HF really spells it 'pre_layrnorm'
+        "ln_pre": _ln_take(take, "vision_model.pre_layrnorm"),
+        "ln_post": _ln_take(take, "vision_model.post_layernorm"),
+    }
+    for i in range(layers):
+        p = f"vision_model.encoder.layers.{i}"
+        params[f"resblock_{i}"] = {
+            "ln_1": _ln_take(take, f"{p}.layer_norm1"),
+            "ln_2": _ln_take(take, f"{p}.layer_norm2"),
+            "attn": {
+                name: {
+                    "kernel": transpose_linear(take(f"{p}.self_attn.{name}.weight")),
+                    "bias": take(f"{p}.self_attn.{name}.bias"),
+                }
+                for name in ("q_proj", "k_proj", "v_proj", "out_proj")
+            },
+            "c_fc": {
+                "kernel": transpose_linear(take(f"{p}.mlp.fc1.weight")),
+                "bias": take(f"{p}.mlp.fc1.bias"),
+            },
+            "c_proj": {
+                "kernel": transpose_linear(take(f"{p}.mlp.fc2.weight")),
+                "bias": take(f"{p}.mlp.fc2.bias"),
+            },
+        }
+    # position_ids is a buffer, not a weight
+    consumed.add(f"{emb}.position_ids")
+    check_all_consumed(sd, consumed, "CLIP-visual(hf)")
+    return params
+
+
+def _ln_take(take, name):
+    return {"scale": take(f"{name}.weight"), "bias": take(f"{name}.bias")}
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray], layers: int = 12) -> Dict:
+    """Auto-detect the checkpoint flavor."""
+    if any(k.startswith("visual.") for k in sd):
+        return from_openai(sd, layers)
+    if any(k.startswith("vision_model.") for k in sd):
+        return from_hf_vision(sd, layers)
+    raise ValueError("unrecognized CLIP checkpoint format")

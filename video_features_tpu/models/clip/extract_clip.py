@@ -1,0 +1,85 @@
+"""CLIP frame-feature extractor (ref models/CLIP/extract_clip.py).
+
+Pipeline per video: ``fix_N``/``uni_N`` frame sampling (ref
+utils/utils.py:297-333) -> PIL bicubic resize + center crop + CLIP
+normalization on the host (byte-identical to the pip ``clip`` package's
+``preprocess``) -> padded static-shape batch -> jit-compiled Flax
+``encode_image`` on the device -> ``{feature_type, fps, timestamps_ms}``.
+
+Returns T x 512 for ViT-B/32 / CLIP4CLIP, T x 512 for ViT-B/16 (ref
+extract_clip.py:126-128; BASELINE.md CLIP contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+from PIL import Image
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import extract_frames
+from video_features_tpu.models.clip.convert import convert_state_dict
+from video_features_tpu.models.clip.model import CONFIGS, VisionTransformer, init_params
+from video_features_tpu.models.common.weights import load_state_dict
+from video_features_tpu.ops.preprocess import (
+    CLIP_MEAN,
+    CLIP_STD,
+    normalize_chw,
+    pil_center_crop,
+    pil_resize,
+    to_float_chw,
+)
+from video_features_tpu.ops.window import bucket_size, pad_batch
+
+
+class ExtractCLIP(BaseExtractor):
+    def __init__(self, config, external_call: bool = False) -> None:
+        super().__init__(config, external_call)
+        if self.config.extract_method is None:
+            raise ValueError(
+                "CLIP extraction needs --extract_method (e.g. uni_12 or fix_2)"
+            )
+        self.model_cfg = CONFIGS[self.feature_type]
+
+    def _build(self, device):
+        model = VisionTransformer(self.model_cfg)
+        if self.config.weights_path:
+            params = convert_state_dict(
+                load_state_dict(self.config.weights_path), self.model_cfg.layers
+            )
+        else:
+            params = init_params(self.model_cfg)
+        params = jax.device_put(params, device)
+
+        @jax.jit
+        def encode_image(p, x):
+            return model.apply({"params": p}, x)
+
+        return {"params": params, "encode_image": encode_image, "device": device}
+
+    def _preprocess(self, frame: np.ndarray) -> np.ndarray:
+        size = self.model_cfg.image_size
+        img = pil_resize(frame, size, interpolation=Image.BICUBIC)
+        img = pil_center_crop(img, size)
+        return normalize_chw(to_float_chw(img), CLIP_MEAN, CLIP_STD)
+
+    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+        video_path = video_path_of(path_entry)
+        frames, fps, timestamps_ms = extract_frames(
+            video_path, self.config.extract_method
+        )
+        batch = np.stack([self._preprocess(f) for f in frames])  # (T, 3, H, W)
+        T = batch.shape[0]
+        padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
+        x = jax.device_put(jnp.asarray(padded), state["device"])
+        feats = np.asarray(state["encode_image"](state["params"], x))[:T]
+        return {
+            self.feature_type: feats,
+            "fps": np.array(fps),
+            "timestamps_ms": np.array(timestamps_ms),
+        }

@@ -1,0 +1,139 @@
+"""CLIP visual transformer in Flax.
+
+The reference consumes OpenAI's pip ``clip`` package (``clip.load`` at ref
+models/CLIP/extract_clip.py:46-63) and only ever calls
+``model.encode_image`` (ref :128). This module is that encoder rebuilt
+TPU-first: NHWC patchify conv, fused qkv attention einsums in fp32 MXU
+precision, QuickGELU MLPs, and a projection head — one jit-compiled
+function per device, batch = sampled frames.
+
+Matches OpenAI ViT-B/32 / B/16 semantics: pre-LN transformer, QuickGELU
+(x * sigmoid(1.702x)), LayerNorm eps 1e-5, class token + learned position
+embeddings, ln_post on the class token, then ``@ proj`` to the embed dim.
+CLIP4CLIP-ViT-B-32 (ref :58-63) is the same graph with a fine-tuned
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+HIGHEST = jax.lax.Precision.HIGHEST
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionConfig:
+    patch_size: int = 32
+    width: int = 768
+    layers: int = 12
+    heads: int = 12
+    embed_dim: int = 512
+    image_size: int = 224
+    quick_gelu: bool = True
+    eps: float = 1e-5
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+
+CLIP_VIT_B32 = CLIPVisionConfig(patch_size=32)
+CLIP_VIT_B16 = CLIPVisionConfig(patch_size=16)
+
+CONFIGS = {
+    "CLIP-ViT-B/32": CLIP_VIT_B32,
+    "CLIP-ViT-B/16": CLIP_VIT_B16,
+    "CLIP4CLIP-ViT-B-32": CLIP_VIT_B32,
+}
+
+
+def quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class Attention(nn.Module):
+    width: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (N, L, D)
+        N, L, D = x.shape
+        hd = self.width // self.heads
+        q = nn.Dense(self.width, name="q_proj")(x)
+        k = nn.Dense(self.width, name="k_proj")(x)
+        v = nn.Dense(self.width, name="v_proj")(x)
+        q = q.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
+        attn = jnp.einsum("nhqd,nhkd->nhqk", q, k, precision=HIGHEST) * (hd ** -0.5)
+        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(x.dtype)
+        out = jnp.einsum("nhqk,nhkd->nhqd", attn, v, precision=HIGHEST)
+        out = out.transpose(0, 2, 1, 3).reshape(N, L, D)
+        return nn.Dense(self.width, name="out_proj")(out)
+
+
+class Block(nn.Module):
+    width: int
+    heads: int
+    quick_gelu: bool
+    eps: float
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        act = quick_gelu if self.quick_gelu else nn.gelu
+        y = nn.LayerNorm(epsilon=self.eps, name="ln_1")(x)
+        x = x + Attention(self.width, self.heads, name="attn")(y)
+        y = nn.LayerNorm(epsilon=self.eps, name="ln_2")(x)
+        y = nn.Dense(self.width * 4, name="c_fc")(y)
+        y = nn.Dense(self.width, name="c_proj")(act(y))
+        return x + y
+
+
+class VisionTransformer(nn.Module):
+    """``encode_image``: (N, 3, H, W) normalized fp32 -> (N, embed_dim)."""
+
+    cfg: CLIPVisionConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = self.cfg
+        N = x.shape[0]
+        x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU-native layout)
+        x = nn.Conv(
+            c.width,
+            (c.patch_size, c.patch_size),
+            strides=(c.patch_size, c.patch_size),
+            use_bias=False,
+            padding="VALID",
+            name="conv1",
+        )(x)
+        x = x.reshape(N, -1, c.width)  # (N, grid*grid, width)
+
+        cls = self.param(
+            "class_embedding", nn.initializers.normal(c.width ** -0.5), (c.width,)
+        )
+        pos = self.param(
+            "positional_embedding",
+            nn.initializers.normal(c.width ** -0.5),
+            (c.grid * c.grid + 1, c.width),
+        )
+        x = jnp.concatenate([jnp.tile(cls[None, None], (N, 1, 1)), x], axis=1)
+        x = x + pos[None]
+        x = nn.LayerNorm(epsilon=c.eps, name="ln_pre")(x)
+        for i in range(c.layers):
+            x = Block(c.width, c.heads, c.quick_gelu, c.eps, name=f"resblock_{i}")(x)
+        x = nn.LayerNorm(epsilon=c.eps, name="ln_post")(x[:, 0])
+        proj = self.param(
+            "proj", nn.initializers.normal(c.width ** -0.5), (c.width, c.embed_dim)
+        )
+        return jnp.dot(x, proj, precision=HIGHEST)
+
+
+def init_params(cfg: CLIPVisionConfig, seed: int = 0):
+    model = VisionTransformer(cfg)
+    dummy = jnp.zeros((1, 3, cfg.image_size, cfg.image_size), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy)["params"]

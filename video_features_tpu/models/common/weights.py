@@ -1,0 +1,85 @@
+"""Checkpoint loading + conversion plumbing shared by every model family.
+
+The reference hardcodes per-model checkpoint paths and pip/URL downloads
+(SURVEY.md §2 #21) and keeps TF->PT weight porters in-tree (ref
+i3d_src/i3d_net.py:277-321) — the precedent for the PT->Flax converters
+that live in each ``models/<family>/convert.py`` here.
+
+Checkpoints are consumed from local files only (this environment has no
+egress): ``.pt``/``.pth`` torch pickles (weights_only load), ``.npz``
+archives, or already-converted flax ``.msgpack``. When no weights are
+given, models run with deterministic random init — feature *values* are
+then meaningless but every pipeline contract (shapes, dtypes, windowing,
+sinks) is exercised, and converters are oracle-tested against randomly
+initialized torch models in tests/.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a torch/npz checkpoint into a flat {name: float32 ndarray}."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"weights not found: {path}")
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    # torch pickle (.pt / .pth / .pytorch)
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    out = {}
+    for k, v in obj.items():
+        if hasattr(v, "numpy"):
+            out[k] = v.detach().to(torch.float32).cpu().numpy()
+    return out
+
+
+def strip_prefix(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    """Drop a leading module prefix (e.g. the 'module.' that the reference's
+    degenerate DataParallel wrapper bakes into RAFT/I3D checkpoints —
+    ref models/raft/extract_raft.py:59)."""
+    if any(k.startswith(prefix) for k in sd):
+        return {k[len(prefix):] if k.startswith(prefix) else k: v for k, v in sd.items()}
+    return sd
+
+
+def transpose_linear(w: np.ndarray) -> np.ndarray:
+    """torch Linear weight (out, in) -> flax Dense kernel (in, out)."""
+    return np.ascontiguousarray(w.T)
+
+
+def conv2d_kernel(w: np.ndarray) -> np.ndarray:
+    """torch Conv2d weight (O, I, kH, kW) -> flax (kH, kW, I, O)."""
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+def conv3d_kernel(w: np.ndarray) -> np.ndarray:
+    """torch Conv3d weight (O, I, kT, kH, kW) -> flax (kT, kH, kW, I, O)."""
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 4, 1, 0)))
+
+
+def check_all_consumed(sd: Dict[str, np.ndarray], consumed, model_name: str) -> None:
+    """Converters must account for every checkpoint tensor — silent drops are
+    how weight-porting bugs hide (SURVEY.md §7 hard part #6)."""
+    left = set(sd) - set(consumed)
+    # num_batches_tracked counters carry no information
+    left = {k for k in left if not k.endswith("num_batches_tracked")}
+    if left:
+        raise ValueError(
+            f"{model_name} converter left {len(left)} tensors unconsumed, e.g. "
+            f"{sorted(left)[:5]}"
+        )
+
+
+def tree_to_device(params: Any, device):
+    import jax
+
+    return jax.device_put(params, device)

@@ -1,0 +1,31 @@
+"""Static-shape helpers: XLA compiles one executable per input shape, so
+variable-length frame batches are padded up to a small set of bucket sizes
+(SURVEY.md §7 hard part #2). The pad rows are sliced off after the model
+runs — features for them are computed and discarded, which on TPU is far
+cheaper than a recompile per length.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def bucket_size(n: int, multiple: int = 8, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest allowed padded size >= n."""
+    if buckets:
+        for b in sorted(buckets):
+            if n <= b:
+                return b
+        return int(math.ceil(n / multiple) * multiple)
+    return max(int(math.ceil(n / multiple) * multiple), multiple)
+
+
+def pad_batch(x: np.ndarray, to: int) -> np.ndarray:
+    """Zero-pad axis 0 of ``x`` up to ``to`` rows."""
+    if x.shape[0] == to:
+        return x
+    pad = [(0, to - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad)
