@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""End-to-end throughput benchmark: videos/sec/chip, CLIP-ViT-B/32 uni_12.
+
+The reference publishes no numbers (BASELINE.md) — its pipeline on GPU is
+decode-bound single-threaded per device. The nominal baseline below (1.0
+videos/s/device for the full decode->preprocess->encode->fetch loop on
+a short clip) stands in for that unpublished number until a measured
+reference run replaces it; ``vs_baseline`` is value/nominal.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "videos/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+NOMINAL_BASELINE_VPS = 1.0  # unpublished reference throughput stand-in
+
+
+def main() -> None:
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+    from video_features_tpu.parallel.devices import resolve_devices
+
+    from video_features_tpu.utils.synth import synth_video
+
+    n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
+    with tempfile.TemporaryDirectory() as tmp:
+        video = synth_video(
+            os.path.join(tmp, "bench.mp4"), n_frames=120, width=640, height=360
+        )
+        cfg = ExtractionConfig(
+            feature_type="CLIP-ViT-B/32",
+            video_paths=[video] * n_videos,
+            extract_method="uni_12",
+            tmp_path=os.path.join(tmp, "t"),
+            output_path=os.path.join(tmp, "o"),
+        )
+        ex = ExtractCLIP(cfg, external_call=True)
+        ex.progress.disable = True
+        device = resolve_devices(cfg)[0]
+        ex([0], device=device)  # warmup: decode path + XLA compile
+        t0 = time.perf_counter()
+        results = ex(range(n_videos), device=device)
+        dt = time.perf_counter() - t0
+        assert len(results) == n_videos and all(
+            r["CLIP-ViT-B/32"].shape == (12, 512) for r in results
+        )
+
+    vps = n_videos / dt
+    print(
+        json.dumps(
+            {
+                "metric": "videos/sec/chip (CLIP-ViT-B/32, uni_12, end-to-end)",
+                "value": round(vps, 3),
+                "unit": "videos/s",
+                "vs_baseline": round(vps / NOMINAL_BASELINE_VPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,29 +33,10 @@ import pytest
 @pytest.fixture(scope="session")
 def sample_video(tmp_path_factory):
     """A small deterministic synthetic mp4 (moving gradient + box)."""
-    import cv2
+    from video_features_tpu.utils.synth import synth_video
 
     path = str(tmp_path_factory.mktemp("media") / "synth.mp4")
-    w, h, fps, n = 320, 240, 25.0, 60
-    writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
-    assert writer.isOpened(), "cv2.VideoWriter could not open mp4 writer"
-    rng = np.random.RandomState(0)
-    for t in range(n):
-        yy, xx = np.mgrid[0:h, 0:w]
-        frame = np.stack(
-            [
-                ((xx + 2 * t) % 256),
-                ((yy + t) % 256),
-                np.full((h, w), (t * 4) % 256),
-            ],
-            axis=-1,
-        ).astype(np.uint8)
-        x0 = (10 + 3 * t) % (w - 40)
-        y0 = (20 + 2 * t) % (h - 40)
-        frame[y0 : y0 + 30, x0 : x0 + 30] = rng.randint(0, 255, 3)
-        writer.write(frame)
-    writer.release()
-    return path
+    return synth_video(path)
 
 
 @pytest.fixture(scope="session")

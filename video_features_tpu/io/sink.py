@@ -42,12 +42,14 @@ def action_on_extraction(
             print(f"max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}")
             print()
         elif on_extraction in ("save_numpy", "save_pickle"):
+            # feature types may contain '/' (CLIP-ViT-B/32); sanitized so
+            # the file name stays flat and '<stem>_<key>' stays greppable
+            # (the reference's np.save would crash on the nested path —
+            # ref utils/utils.py:81-93 only makes output_path)
+            safe_key = key.replace("/", "-")
             fname = f"{name}.{suffix[on_extraction]}" if output_direct \
-                else f"{name}_{key}.{suffix[on_extraction]}"
+                else f"{name}_{safe_key}.{suffix[on_extraction]}"
             fpath = os.path.join(output_path, fname)
-            # feature types may contain '/' (CLIP-ViT-B/32) which nests the
-            # path; create the full leaf dir (the reference's np.save would
-            # crash here — ref utils/utils.py:81-93 only makes output_path)
             os.makedirs(os.path.dirname(fpath), exist_ok=True)
             if len(value) == 0:
                 print(f"Warning: the value is empty for {key} @ {fpath}")

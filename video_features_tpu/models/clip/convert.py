@@ -85,8 +85,18 @@ def from_openai(sd: Dict[str, np.ndarray], layers: int = 12) -> Dict:
 
 
 def from_hf_vision(sd: Dict[str, np.ndarray], layers: int = 12) -> Dict:
-    """HF CLIPVisionModelWithProjection state dict -> flax params."""
-    sd = {k: np.asarray(val, np.float32) for k, val in sd.items()}
+    """HF CLIPVisionModelWithProjection state dict -> flax params.
+
+    Full ``CLIPModel`` checkpoints work too: text-tower tensors are
+    filtered out up front, mirroring ``from_openai``'s visual-only filter.
+    """
+    sd = {
+        k: np.asarray(val, np.float32)
+        for k, val in sd.items()
+        if k.startswith(("vision_model.", "visual_projection."))
+    }
+    if not sd:
+        raise ValueError("no 'vision_model.*' tensors found — not an HF CLIP checkpoint?")
     consumed = set()
 
     def take(key):

@@ -45,16 +45,22 @@ class ExtractCLIP(BaseExtractor):
                 "CLIP extraction needs --extract_method (e.g. uni_12 or fix_2)"
             )
         self.model_cfg = CONFIGS[self.feature_type]
+        self._host_params = None  # converted once, device_put per device
+
+    def _load_host_params(self):
+        # called under _build_lock (warmup serializes _build calls)
+        if self._host_params is None:
+            if self.config.weights_path:
+                self._host_params = convert_state_dict(
+                    load_state_dict(self.config.weights_path), self.model_cfg.layers
+                )
+            else:
+                self._host_params = init_params(self.model_cfg)
+        return self._host_params
 
     def _build(self, device):
         model = VisionTransformer(self.model_cfg)
-        if self.config.weights_path:
-            params = convert_state_dict(
-                load_state_dict(self.config.weights_path), self.model_cfg.layers
-            )
-        else:
-            params = init_params(self.model_cfg)
-        params = jax.device_put(params, device)
+        params = jax.device_put(self._load_host_params(), device)
 
         @jax.jit
         def encode_image(p, x):

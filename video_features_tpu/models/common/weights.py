@@ -29,7 +29,21 @@ def load_state_dict(path: str) -> Dict[str, np.ndarray]:
     if path.endswith(".npz"):
         with np.load(path) as z:
             return {k: np.asarray(z[k]) for k in z.files}
-    # torch pickle (.pt / .pth / .pytorch)
+    if path.endswith(".msgpack"):
+        from flax import serialization, traverse_util
+
+        with open(path, "rb") as f:
+            tree = serialization.msgpack_restore(f.read())
+        return {
+            ".".join(k): np.asarray(v)
+            for k, v in traverse_util.flatten_dict(tree).items()
+        }
+    if not path.endswith((".pt", ".pth", ".pytorch", ".bin")):
+        raise ValueError(
+            f"unsupported checkpoint format: {path} "
+            "(expected .npz, .msgpack, or a torch pickle .pt/.pth/.pytorch/.bin)"
+        )
+    # torch pickle
     import torch
 
     obj = torch.load(path, map_location="cpu", weights_only=True)

@@ -1,0 +1,102 @@
+"""Multi-chip sharding: mesh construction + GSPMD partition rules.
+
+The reference has no inter-device communication at all — its only
+parallelism is a static split of the video list across GPU threads (ref
+main.py:49-55; SURVEY.md §2 parallelism table). The TPU-native framework
+keeps that embarrassingly-parallel outer loop (parallel/scheduler.py) and
+*adds* what the reference cannot do: sharded execution of one model call
+across a ``jax.sharding.Mesh``, with XLA inserting the ICI collectives.
+
+Axes:
+- ``data``  — the frame/stack axis of one extraction batch. For video
+  models this is also the *time* axis, so sharding it is the framework's
+  sequence-parallel story: a long video's frame batch spreads over chips.
+- ``model`` — tensor parallelism over attention heads / MLP hidden dim
+  (Megatron-style column->row sharding, expressed purely as PartitionSpecs;
+  the psum after the row-sharded matmul is inserted by GSPMD).
+
+Multi-host: the same mesh built from ``jax.devices()`` after
+``jax.distributed.initialize`` spans hosts; specs are unchanged (DCN for
+dispatch, ICI for the collectives).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    data: Optional[int] = None,
+    model: int = 1,
+) -> Mesh:
+    """A (data, model) mesh over ``devices`` (default: all of them)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} needs more than {n} devices")
+    arr = np.asarray(devices[: data * model], dtype=object).reshape(data, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def _path_names(path) -> list:
+    return [p.key for p in path if hasattr(p, "key")]
+
+
+def clip_vit_param_specs(params):
+    """Megatron-style TP specs for models/clip/model.py's VisionTransformer.
+
+    Column-parallel (shard output features over 'model'): q/k/v projections
+    and the MLP up-projection ``c_fc``. Row-parallel (shard input features;
+    GSPMD adds the psum): ``out_proj`` and the MLP down-projection
+    ``c_proj``. Everything else (LayerNorms, embeddings, patchify conv,
+    final proj) is replicated — it is tiny next to the block weights.
+    """
+
+    def spec(path, leaf):
+        names = _path_names(path)
+        parent = names[-2] if len(names) > 1 else ""
+        last = names[-1] if names else ""
+        if parent in ("q_proj", "k_proj", "v_proj", "c_fc"):
+            return P(None, "model") if last == "kernel" else P("model")
+        if parent in ("out_proj", "c_proj") and last == "kernel":
+            return P("model", None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_params(params, mesh: Mesh, specs=None):
+    """Place a param tree onto ``mesh`` under ``specs`` (default: CLIP TP)."""
+    if specs is None:
+        specs = clip_vit_param_specs(params)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    return jax.device_put(params, shardings)
+
+
+def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data")):
+    """jit ``model.apply`` with the batch sharded over 'data'.
+
+    Returns ``fn(params, x)``; params should already be placed with
+    ``shard_params`` (their shardings flow into the jit as arguments).
+    """
+    x_sharding = NamedSharding(mesh, batch_spec)
+    out_sharding = NamedSharding(mesh, P("data"))
+
+    @partial(jax.jit, out_shardings=out_sharding)
+    def fn(p, x):
+        x = jax.lax.with_sharding_constraint(x, x_sharding)
+        return model.apply({"params": p}, x)
+
+    return fn

@@ -1,0 +1,44 @@
+"""Synthetic media generation shared by tests and bench.py.
+
+This environment has no ffmpeg binary and zero egress (no sample
+downloads), so deterministic cv2-written clips stand in for real videos:
+a moving gradient (smooth global motion for flow models) plus a random
+box (texture + occlusion edges).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synth_video(
+    path: str,
+    n_frames: int = 60,
+    width: int = 320,
+    height: int = 240,
+    fps: float = 25.0,
+    seed: int = 0,
+) -> str:
+    import cv2
+
+    writer = cv2.VideoWriter(
+        path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (width, height)
+    )
+    assert writer.isOpened(), "cv2.VideoWriter could not open mp4 writer"
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:height, 0:width]
+    for t in range(n_frames):
+        frame = np.stack(
+            [
+                (xx + 2 * t) % 256,
+                (yy + t) % 256,
+                np.full((height, width), (t * 4) % 256),
+            ],
+            axis=-1,
+        ).astype(np.uint8)
+        x0 = (10 + 3 * t) % (width - 40)
+        y0 = (20 + 2 * t) % (height - 40)
+        frame[y0 : y0 + 30, x0 : x0 + 30] = rng.randint(0, 255, 3)
+        writer.write(frame)
+    writer.release()
+    return path
