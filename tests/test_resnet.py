@@ -1,0 +1,201 @@
+"""ResNet parity vs a torch oracle + end-to-end extraction.
+
+torchvision is not installed in this environment, so the oracle is a
+minimal torch reimplementation of torchvision's ResNet v1 with
+state-dict-compatible parameter names (conv1, bn1, layer{s}.{b}.*,
+downsample.{0,1}, fc) — randomized weights AND randomized BN running
+stats so the converter's stat plumbing is actually exercised.
+"""
+
+import numpy as np
+import pytest
+import torch
+from torch import nn
+
+import jax.numpy as jnp
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.models.resnet.convert import convert_state_dict
+from video_features_tpu.models.resnet.model import ARCHS, build
+
+
+class TorchBasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return torch.relu(out + identity)
+
+
+class TorchBottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = torch.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return torch.relu(out + identity)
+
+
+class TorchResNet(nn.Module):
+    def __init__(self, block, layers, num_classes=1000):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, n, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2d(self.inplanes, planes * block.expansion, 1, stride, bias=False),
+                nn.BatchNorm2d(planes * block.expansion),
+            )
+        blocks = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, n):
+            blocks.append(block(self.inplanes, planes))
+        return nn.Sequential(*blocks)
+
+    def forward(self, x):
+        x = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        feats = torch.flatten(self.avgpool(x), 1)
+        return feats, self.fc(feats)
+
+
+def _torch_oracle(arch: str, seed: int = 0) -> TorchResNet:
+    block = TorchBasicBlock if ARCHS[arch][0].__name__ == "BasicBlock" else TorchBottleneck
+    torch.manual_seed(seed)
+    model = TorchResNet(block, list(ARCHS[arch][1]))
+    # randomize BN running stats so converted stats actually matter
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, nn.BatchNorm2d):
+                m.running_mean.normal_(0, 0.5)
+                m.running_var.uniform_(0.5, 2.0)
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
+def test_resnet_matches_torch_oracle(arch):
+    oracle = _torch_oracle(arch)
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd, arch)
+
+    x = np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32)
+    with torch.no_grad():
+        ref_feats, ref_logits = oracle(torch.from_numpy(x))
+    feats, logits = build(arch).apply({"params": params}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(feats), ref_feats.numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits.numpy(), atol=1e-4)
+
+
+def test_converter_rejects_unconsumed():
+    oracle = _torch_oracle("resnet18")
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    sd["stray.weight"] = np.zeros(3, np.float32)
+    with pytest.raises(ValueError, match="unconsumed"):
+        convert_state_dict(sd, "resnet18")
+
+
+def test_msgpack_weights_roundtrip(tmp_path):
+    """Already-converted flax params saved as .msgpack load without going
+    through the torch-key converter."""
+    from flax import serialization
+
+    from video_features_tpu.models.common.weights import load_params
+    from video_features_tpu.models.resnet.model import init_params
+
+    params = init_params("resnet18")
+    path = str(tmp_path / "rn18.msgpack")
+    with open(path, "wb") as f:
+        f.write(serialization.msgpack_serialize(params))
+
+    def _fail(sd):
+        raise AssertionError("converter must not run for .msgpack")
+
+    loaded = load_params(path, _fail)
+    x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+    a, _ = build("resnet18").apply({"params": params}, jnp.asarray(x))
+    b, _ = build("resnet18").apply({"params": loaded}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_extract_resnet_end_to_end(sample_video, tmp_path):
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    cfg = ExtractionConfig(
+        feature_type="resnet18",
+        video_paths=[sample_video],
+        extraction_fps=5.0,  # 60-frame 25fps synth clip -> 12 frames
+        batch_size=5,
+        on_extraction="save_numpy",
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    ex = ExtractResNet(cfg)
+    ex([0])
+    import pathlib
+
+    saved = {p.name: p for p in pathlib.Path(tmp_path / "out").rglob("*.npy")}
+    # meta keys (fps, timestamps_ms) are never saved (ref utils/utils.py:70-72)
+    assert set(saved) == {"synth_resnet18.npy"}
+    feats = np.load(saved["synth_resnet18.npy"])
+    assert feats.shape[1] == 512 and feats.shape[0] >= 10
+    assert np.isfinite(feats).all()
+
+
+def test_extract_resnet_show_pred(sample_video, tmp_path, capsys):
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    cfg = ExtractionConfig(
+        feature_type="resnet18",
+        video_paths=[sample_video],
+        extraction_fps=1.0,
+        batch_size=4,
+        show_pred=True,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    res = ExtractResNet(cfg, external_call=True)([0])
+    out = capsys.readouterr().out
+    assert len(out.strip().splitlines()) >= 5  # top-5 lines per batch
+    assert res[0]["resnet18"].shape[1] == 512
+    # timestamps follow the 1 fps grid
+    np.testing.assert_allclose(np.diff(res[0]["timestamps_ms"]), 1000.0)

@@ -25,7 +25,7 @@ from video_features_tpu.io.paths import video_path_of
 from video_features_tpu.io.video import extract_frames
 from video_features_tpu.models.clip.convert import convert_state_dict
 from video_features_tpu.models.clip.model import CONFIGS, VisionTransformer, init_params
-from video_features_tpu.models.common.weights import load_state_dict
+from video_features_tpu.models.common.weights import load_params
 from video_features_tpu.ops.preprocess import (
     CLIP_MEAN,
     CLIP_STD,
@@ -51,8 +51,9 @@ class ExtractCLIP(BaseExtractor):
         # called under _build_lock (warmup serializes _build calls)
         if self._host_params is None:
             if self.config.weights_path:
-                self._host_params = convert_state_dict(
-                    load_state_dict(self.config.weights_path), self.model_cfg.layers
+                self._host_params = load_params(
+                    self.config.weights_path,
+                    lambda sd: convert_state_dict(sd, self.model_cfg.layers),
                 )
             else:
                 self._host_params = init_params(self.model_cfg)

@@ -29,19 +29,11 @@ def load_state_dict(path: str) -> Dict[str, np.ndarray]:
     if path.endswith(".npz"):
         with np.load(path) as z:
             return {k: np.asarray(z[k]) for k in z.files}
-    if path.endswith(".msgpack"):
-        from flax import serialization, traverse_util
-
-        with open(path, "rb") as f:
-            tree = serialization.msgpack_restore(f.read())
-        return {
-            ".".join(k): np.asarray(v)
-            for k, v in traverse_util.flatten_dict(tree).items()
-        }
     if not path.endswith((".pt", ".pth", ".pytorch", ".bin")):
         raise ValueError(
             f"unsupported checkpoint format: {path} "
-            "(expected .npz, .msgpack, or a torch pickle .pt/.pth/.pytorch/.bin)"
+            "(expected .npz or a torch pickle .pt/.pth/.pytorch/.bin; "
+            "already-converted flax .msgpack goes through load_params)"
         )
     # torch pickle
     import torch
@@ -54,6 +46,27 @@ def load_state_dict(path: str) -> Dict[str, np.ndarray]:
         if hasattr(v, "numpy"):
             out[k] = v.detach().to(torch.float32).cpu().numpy()
     return out
+
+
+def load_params(path: str, convert) -> Any:
+    """Load model params for an extractor.
+
+    ``.msgpack`` holds an already-converted flax param tree (saved with
+    ``flax.serialization.msgpack_serialize``) and is returned as-is;
+    anything else is a source-framework state dict that goes through
+    ``load_state_dict`` + the family's ``convert`` function.
+    """
+    if path.endswith(".msgpack"):
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"weights not found: {path}")
+        from flax import serialization
+
+        with open(path, "rb") as f:
+            tree = serialization.msgpack_restore(f.read())
+        if isinstance(tree, dict) and set(tree) == {"params"}:
+            tree = tree["params"]
+        return tree
+    return convert(load_state_dict(path))
 
 
 def strip_prefix(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:

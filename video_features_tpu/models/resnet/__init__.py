@@ -1,0 +1,1 @@
+from video_features_tpu.models.resnet.model import ARCHS, ResNet, init_params  # noqa: F401

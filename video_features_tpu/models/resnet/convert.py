@@ -1,0 +1,67 @@
+"""torchvision ResNet checkpoint -> Flax param tree.
+
+Consumes the standard torchvision state-dict naming (``conv1.weight``,
+``layer{s}.{b}.conv{k}.weight``, ``layer{s}.{b}.downsample.{0,1}.*``,
+``fc.*``) that the reference loads via ``torchvision.models.resnetXX
+(pretrained=True)`` (ref models/resnet/extract_resnet.py:52-63).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    check_all_consumed,
+    conv2d_kernel,
+    strip_prefix,
+    transpose_linear,
+)
+from video_features_tpu.models.resnet.model import ARCHS
+
+
+def _bn(sd: Dict[str, np.ndarray], prefix: str, consumed) -> Dict[str, np.ndarray]:
+    consumed.update(
+        f"{prefix}.{s}" for s in ("weight", "bias", "running_mean", "running_var")
+    )
+    return {
+        "scale": sd[f"{prefix}.weight"],
+        "bias": sd[f"{prefix}.bias"],
+        "mean": sd[f"{prefix}.running_mean"],
+        "var": sd[f"{prefix}.running_var"],
+    }
+
+
+def _conv(sd: Dict[str, np.ndarray], name: str, consumed) -> Dict[str, np.ndarray]:
+    consumed.add(f"{name}.weight")
+    return {"kernel": conv2d_kernel(sd[f"{name}.weight"])}
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray], arch: str):
+    block, layers = ARCHS[arch]
+    n_convs = 2 if block.__name__ == "BasicBlock" else 3
+    sd = strip_prefix(sd, "module.")
+    consumed = set()
+    params = {
+        "conv1": _conv(sd, "conv1", consumed),
+        "bn1": _bn(sd, "bn1", consumed),
+        "fc": {
+            "kernel": transpose_linear(sd["fc.weight"]),
+            "bias": sd["fc.bias"],
+        },
+    }
+    consumed.update(("fc.weight", "fc.bias"))
+    for stage, n_blocks in enumerate(layers):
+        for b in range(n_blocks):
+            ref = f"layer{stage + 1}.{b}"
+            blk = {}
+            for k in range(1, n_convs + 1):
+                blk[f"conv{k}"] = _conv(sd, f"{ref}.conv{k}", consumed)
+                blk[f"bn{k}"] = _bn(sd, f"{ref}.bn{k}", consumed)
+            if f"{ref}.downsample.0.weight" in sd:
+                blk["downsample_conv"] = _conv(sd, f"{ref}.downsample.0", consumed)
+                blk["downsample_bn"] = _bn(sd, f"{ref}.downsample.1", consumed)
+            params[f"layer{stage + 1}_{b}"] = blk
+    check_all_consumed(sd, consumed, f"ResNet[{arch}]")
+    return params

@@ -1,0 +1,99 @@
+"""ResNet frame-feature extractor (ref models/resnet/extract_resnet.py).
+
+Per video: streaming cv2 decode (optionally on an ``--extraction_fps``
+grid — done in-process, no ffmpeg re-encode subprocess), torchvision
+Resize(256)/CenterCrop(224)/Normalize on the host, frames batched to the
+static ``--batch_size`` shape (partial tail batches are zero-padded so XLA
+compiles exactly one executable), jit forward returning features AND
+logits in one pass, ``--show_pred`` printing top-5 ImageNet classes
+(ref extract_resnet.py:112-114, utils/utils.py:19-46).
+
+Output contract: ``{resnetXX: (T, feat_dim), fps, timestamps_ms}``
+(ref extract_resnet.py:162-167); 2048-d for resnet50+ (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import stream_frames
+from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.resnet.convert import convert_state_dict
+from video_features_tpu.models.resnet.model import build, init_params
+from video_features_tpu.ops.preprocess import imagenet_preprocess
+from video_features_tpu.utils.labels import show_predictions_on_dataset
+
+
+class ExtractResNet(BaseExtractor):
+    def __init__(self, config, external_call: bool = False) -> None:
+        super().__init__(config, external_call)
+        self.batch_size = max(int(self.config.batch_size or 1), 1)
+        self._host_params = None
+
+    def _load_host_params(self):
+        if self._host_params is None:
+            if self.config.weights_path:
+                self._host_params = load_params(
+                    self.config.weights_path,
+                    lambda sd: convert_state_dict(sd, self.feature_type),
+                )
+            else:
+                self._host_params = init_params(self.feature_type)
+        return self._host_params
+
+    def _build(self, device):
+        model = build(self.feature_type)
+        params = jax.device_put(self._load_host_params(), device)
+
+        @jax.jit
+        def forward(p, x):
+            return model.apply({"params": p}, x)
+
+        return {"params": params, "forward": forward, "device": device}
+
+    def _run_batch(self, state, batch: List[np.ndarray], feats_out: List[np.ndarray]):
+        """Pad to the static batch size, run, keep the valid rows
+        (ref extract_resnet.py:104-116)."""
+        n = len(batch)
+        x = np.stack(batch)
+        if n < self.batch_size:
+            x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
+        x = jax.device_put(jnp.asarray(x), state["device"])
+        feats, logits = state["forward"](state["params"], x)
+        feats_out.append(np.asarray(feats)[:n])
+        if self.config.show_pred:
+            show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
+
+    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+        video_path = video_path_of(path_entry)
+        fps = self.config.extraction_fps
+        batch: List[np.ndarray] = []
+        feats_out: List[np.ndarray] = []
+        timestamps_ms: List[float] = []
+        actual_fps = None
+        for frame, ts in stream_frames(video_path, fps):
+            batch.append(imagenet_preprocess(frame))
+            timestamps_ms.append(ts)
+            if len(batch) == self.batch_size:
+                self._run_batch(state, batch, feats_out)
+                batch = []
+        if batch:
+            self._run_batch(state, batch, feats_out)
+        if not feats_out:
+            raise IOError(f"no frames decoded from {video_path}")
+        if actual_fps is None:
+            from video_features_tpu.io.video import probe
+
+            actual_fps = fps or probe(video_path).fps or 25.0
+        return {
+            self.feature_type: np.concatenate(feats_out, axis=0),
+            "fps": np.array(actual_fps),
+            "timestamps_ms": np.array(timestamps_ms),
+        }

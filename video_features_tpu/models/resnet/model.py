@@ -1,0 +1,155 @@
+"""ResNet-18/34/50/101/152 in Flax (inference graph).
+
+The reference takes these from ``torchvision.models`` and swaps ``fc`` for
+``Identity`` while keeping the classifier head around for ``--show_pred``
+(ref models/resnet/extract_resnet.py:52-71). Here the graph is rebuilt
+TPU-first: NHWC layout end-to-end, BatchNorm folded to a single
+multiply-add at apply time (inference only — running stats are params),
+and the forward returns ``(features, logits)`` in one pass so the debug
+rail costs one extra matmul, not a second traversal.
+
+Semantics match torchvision's ResNet v1: 7x7/2 stem conv + BN + ReLU +
+3x3/2 maxpool, four stages of BasicBlock (18/34) or Bottleneck (50+,
+expansion 4, stride on conv2), global average pool, 1000-way fc.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class EvalBatchNorm(nn.Module):
+    """Inference-mode BatchNorm: running stats are plain params.
+
+    Folds to ``x * inv + shift`` where ``inv = scale / sqrt(var + eps)`` —
+    one fused multiply-add that XLA merges into the preceding conv.
+    """
+
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        C = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (C,))
+        bias = self.param("bias", nn.initializers.zeros, (C,))
+        mean = self.param("mean", nn.initializers.zeros, (C,))
+        var = self.param("var", nn.initializers.ones, (C,))
+        inv = scale * jax.lax.rsqrt(var + self.eps)
+        return x * inv + (bias - mean * inv)
+
+
+def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
+    pad = (kernel - 1) // 2
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        use_bias=False,
+        name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        identity = x
+        out = _conv(self.planes, 3, self.stride, name="conv1")(x)
+        out = EvalBatchNorm(name="bn1")(out)
+        out = nn.relu(out)
+        out = _conv(self.planes, 3, 1, name="conv2")(out)
+        out = EvalBatchNorm(name="bn2")(out)
+        if self.downsample:
+            identity = _conv(self.planes, 1, self.stride, name="downsample_conv")(x)
+            identity = EvalBatchNorm(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        identity = x
+        out = _conv(self.planes, 1, 1, name="conv1")(x)
+        out = nn.relu(EvalBatchNorm(name="bn1")(out))
+        out = _conv(self.planes, 3, self.stride, name="conv2")(out)
+        out = nn.relu(EvalBatchNorm(name="bn2")(out))
+        out = _conv(self.planes * 4, 1, 1, name="conv3")(out)
+        out = EvalBatchNorm(name="bn3")(out)
+        if self.downsample:
+            identity = _conv(self.planes * 4, 1, self.stride, name="downsample_conv")(x)
+            identity = EvalBatchNorm(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+# feature_type -> (block, per-stage block counts), mirroring torchvision
+ARCHS = {
+    "resnet18": (BasicBlock, (2, 2, 2, 2)),
+    "resnet34": (BasicBlock, (3, 4, 6, 3)),
+    "resnet50": (Bottleneck, (3, 4, 6, 3)),
+    "resnet101": (Bottleneck, (3, 4, 23, 3)),
+    "resnet152": (Bottleneck, (3, 8, 36, 3)),
+}
+
+
+def feature_dim(arch: str) -> int:
+    block, _ = ARCHS[arch]
+    return 512 * block.expansion
+
+
+class ResNet(nn.Module):
+    """(N, 3, H, W) normalized fp32 -> (features (N, 512*exp), logits (N, classes))."""
+
+    block: Type[nn.Module]
+    layers: Sequence[int]
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU-native layout)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, name="conv1",
+        )(x)
+        x = nn.relu(EvalBatchNorm(name="bn1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        in_planes = 64
+        for stage, n_blocks in enumerate(self.layers):
+            planes = 64 * (2 ** stage)
+            stride = 1 if stage == 0 else 2
+            for b in range(n_blocks):
+                s = stride if b == 0 else 1
+                need_ds = s != 1 or in_planes != planes * self.block.expansion
+                x = self.block(
+                    planes, s, need_ds, name=f"layer{stage + 1}_{b}"
+                )(x)
+                in_planes = planes * self.block.expansion
+
+        feats = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = nn.Dense(self.num_classes, name="fc")(feats)
+        return feats, logits
+
+
+def build(arch: str, num_classes: int = 1000) -> ResNet:
+    block, layers = ARCHS[arch]
+    return ResNet(block=block, layers=layers, num_classes=num_classes)
+
+
+def init_params(arch: str, seed: int = 0, num_classes: int = 1000):
+    model = build(arch, num_classes)
+    dummy = jnp.zeros((1, 3, 224, 224), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy)["params"]

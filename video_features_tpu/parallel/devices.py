@@ -10,7 +10,28 @@ models/resnet/extract_resnet.py:52-71).
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
+
+
+def pin_platform(platform: Optional[str] = None) -> None:
+    """Re-assert the jax platform through the config API (None = from the
+    JAX_PLATFORMS env var; no-op if neither is set).
+
+    TPU plugins (axon) register a backend-discovery hook that ignores the
+    JAX_PLATFORMS env var captured at interpreter startup and dials the
+    chip tunnel — which can block for minutes. Pinning via the config API
+    skips discovery entirely; harmless if backends are already up. Every
+    entry point must call this before touching jax devices.
+    """
+    import jax
+
+    platform = platform or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
 
 
 def resolve_devices(cfg=None, *, cpu: Optional[bool] = None,
@@ -20,6 +41,8 @@ def resolve_devices(cfg=None, *, cpu: Optional[bool] = None,
     if cfg is not None:
         cpu = cfg.cpu if cpu is None else cpu
         device_ids = cfg.device_ids if device_ids is None else device_ids
+    # --cpu wins over the env: a --cpu run must never touch the TPU runtime.
+    pin_platform("cpu" if cpu else None)
     if cpu:
         return [jax.local_devices(backend="cpu")[0]]
     devices = list(jax.devices())
