@@ -1,0 +1,27 @@
+"""Shared inference-graph layers used across model families."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class EvalBatchNorm(nn.Module):
+    """Inference-mode BatchNorm: running stats are plain params.
+
+    Folds to ``x * inv + shift`` where ``inv = scale / sqrt(var + eps)`` —
+    one fused multiply-add that XLA merges into the preceding conv.
+    """
+
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        C = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (C,))
+        bias = self.param("bias", nn.initializers.zeros, (C,))
+        mean = self.param("mean", nn.initializers.zeros, (C,))
+        var = self.param("var", nn.initializers.ones, (C,))
+        inv = scale * jax.lax.rsqrt(var + self.eps)
+        return x * inv + (bias - mean * inv)

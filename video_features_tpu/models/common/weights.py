@@ -93,6 +93,20 @@ def conv3d_kernel(w: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(np.transpose(w, (2, 3, 4, 1, 0)))
 
 
+def bn_params(sd: Dict[str, np.ndarray], prefix: str, consumed) -> Dict[str, np.ndarray]:
+    """torch BatchNorm state (weight/bias/running_mean/running_var) ->
+    EvalBatchNorm params (scale/bias/mean/var), marking keys consumed."""
+    consumed.update(
+        f"{prefix}.{s}" for s in ("weight", "bias", "running_mean", "running_var")
+    )
+    return {
+        "scale": sd[f"{prefix}.weight"],
+        "bias": sd[f"{prefix}.bias"],
+        "mean": sd[f"{prefix}.running_mean"],
+        "var": sd[f"{prefix}.running_var"],
+    }
+
+
 def check_all_consumed(sd: Dict[str, np.ndarray], consumed, model_name: str) -> None:
     """Converters must account for every checkpoint tensor — silent drops are
     how weight-porting bugs hide (SURVEY.md §7 hard part #6)."""

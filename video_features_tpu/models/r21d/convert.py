@@ -1,0 +1,66 @@
+"""torchvision VideoResNet (r2plus1d_18) checkpoint -> Flax param tree.
+
+Consumes the standard torchvision naming the reference loads via
+``r2plus1d_18(pretrained=True)`` (ref models/r21d/extract_r21d.py:58-62):
+``stem.{0,1,3,4}``, ``layer{s}.{b}.conv{k}.0.{0,1,3}`` (spatial conv /
+mid BN / temporal conv inside Conv2Plus1D), ``layer{s}.{b}.conv{k}.1``
+(post-factorization BN), ``layer{s}.{b}.downsample.{0,1}``, ``fc``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    bn_params as _bn,
+    check_all_consumed,
+    conv3d_kernel,
+    strip_prefix,
+    transpose_linear,
+)
+
+
+def _conv(sd: Dict[str, np.ndarray], name: str, consumed) -> Dict[str, np.ndarray]:
+    consumed.add(f"{name}.weight")
+    return {"kernel": conv3d_kernel(sd[f"{name}.weight"])}
+
+
+def _conv2plus1d(sd: Dict[str, np.ndarray], prefix: str, consumed):
+    return {
+        "spatial": _conv(sd, f"{prefix}.0", consumed),
+        "bn_mid": _bn(sd, f"{prefix}.1", consumed),
+        "temporal": _conv(sd, f"{prefix}.3", consumed),
+    }
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray], layers=(2, 2, 2, 2)):
+    sd = strip_prefix(sd, "module.")
+    consumed = set()
+    params = {
+        "stem_conv1": _conv(sd, "stem.0", consumed),
+        "stem_bn1": _bn(sd, "stem.1", consumed),
+        "stem_conv2": _conv(sd, "stem.3", consumed),
+        "stem_bn2": _bn(sd, "stem.4", consumed),
+        "fc": {
+            "kernel": transpose_linear(sd["fc.weight"]),
+            "bias": sd["fc.bias"],
+        },
+    }
+    consumed.update(("fc.weight", "fc.bias"))
+    for stage, n_blocks in enumerate(layers):
+        for b in range(n_blocks):
+            ref = f"layer{stage + 1}.{b}"
+            blk = {
+                "conv1": _conv2plus1d(sd, f"{ref}.conv1.0", consumed),
+                "bn1": _bn(sd, f"{ref}.conv1.1", consumed),
+                "conv2": _conv2plus1d(sd, f"{ref}.conv2.0", consumed),
+                "bn2": _bn(sd, f"{ref}.conv2.1", consumed),
+            }
+            if f"{ref}.downsample.0.weight" in sd:
+                blk["downsample_conv"] = _conv(sd, f"{ref}.downsample.0", consumed)
+                blk["downsample_bn"] = _bn(sd, f"{ref}.downsample.1", consumed)
+            params[f"layer{stage + 1}_{b}"] = blk
+    check_all_consumed(sd, consumed, "R2Plus1D")
+    return params

@@ -1,0 +1,146 @@
+"""R(2+1)D-18 in Flax (inference graph).
+
+The reference uses torchvision's ``r2plus1d_18`` pretrained on
+Kinetics-400 (ref models/r21d/extract_r21d.py:9,58-62). The graph is
+rebuilt TPU-first: NTHWC layout end-to-end (channels-last 3D convs tile
+straight onto the MXU), inference BatchNorm folded to one multiply-add,
+and forward returning ``(features, logits)`` in a single pass so
+``--show_pred`` costs one extra matmul.
+
+Architecture (torchvision VideoResNet): R(2+1)D stem — 1x7x7/1,2,2
+spatial conv to 45 ch + BN + ReLU, then 3x1x1 temporal conv to 64 +
+BN + ReLU — followed by four stages of 2 BasicBlocks whose 3D convs are
+factorized into spatial (1x3x3) + BN + ReLU + temporal (3x1x1) pairs
+with the midplane count chosen to match the parameter budget of the full
+3x3x3 conv; global average pool; 400-way fc.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from video_features_tpu.models.common.layers import EvalBatchNorm
+
+R21D_FEATURE_DIM = 512
+
+
+def midplanes(in_ch: int, out_ch: int) -> int:
+    """Parameter-matching width of the factorized conv's intermediate
+    (torchvision Conv2Plus1D): ``(in*out*3^3) // (in*3^2 + 3*out)``."""
+    return (in_ch * out_ch * 3 * 3 * 3) // (in_ch * 3 * 3 + 3 * out_ch)
+
+
+class Conv2Plus1D(nn.Module):
+    """Factorized 3D conv: spatial 1x3x3 -> BN -> ReLU -> temporal 3x1x1."""
+
+    mid: int
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(
+            self.mid,
+            (1, 3, 3),
+            strides=(1, self.stride, self.stride),
+            padding=[(0, 0), (1, 1), (1, 1)],
+            use_bias=False,
+            name="spatial",
+        )(x)
+        x = nn.relu(EvalBatchNorm(name="bn_mid")(x))
+        x = nn.Conv(
+            self.features,
+            (3, 1, 1),
+            strides=(self.stride, 1, 1),
+            padding=[(1, 1), (0, 0), (0, 0)],
+            use_bias=False,
+            name="temporal",
+        )(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        # torchvision computes the midplane width once from (inplanes, planes)
+        # and reuses it for BOTH factorized convs of the block
+        mid = midplanes(in_ch, self.planes)
+        identity = x
+        out = Conv2Plus1D(mid, self.planes, self.stride, name="conv1")(x)
+        out = nn.relu(EvalBatchNorm(name="bn1")(out))
+        out = Conv2Plus1D(mid, self.planes, 1, name="conv2")(out)
+        out = EvalBatchNorm(name="bn2")(out)
+        if self.downsample:
+            identity = nn.Conv(
+                self.planes,
+                (1, 1, 1),
+                strides=(self.stride,) * 3,
+                use_bias=False,
+                name="downsample_conv",
+            )(x)
+            identity = EvalBatchNorm(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class R2Plus1D(nn.Module):
+    """(N, T, H, W, 3) normalized fp32 -> (features (N, 512), logits (N, classes))."""
+
+    layers: Sequence[int] = (2, 2, 2, 2)
+    num_classes: int = 400
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = nn.Conv(
+            45,
+            (1, 7, 7),
+            strides=(1, 2, 2),
+            padding=[(0, 0), (3, 3), (3, 3)],
+            use_bias=False,
+            name="stem_conv1",
+        )(x)
+        x = nn.relu(EvalBatchNorm(name="stem_bn1")(x))
+        x = nn.Conv(
+            64,
+            (3, 1, 1),
+            strides=(1, 1, 1),
+            padding=[(1, 1), (0, 0), (0, 0)],
+            use_bias=False,
+            name="stem_conv2",
+        )(x)
+        x = nn.relu(EvalBatchNorm(name="stem_bn2")(x))
+
+        in_planes = 64
+        for stage, n_blocks in enumerate(self.layers):
+            planes = 64 * (2 ** stage)
+            stride = 1 if stage == 0 else 2
+            for b in range(n_blocks):
+                s = stride if b == 0 else 1
+                need_ds = s != 1 or in_planes != planes
+                x = self.block_apply(x, planes, s, need_ds, f"layer{stage + 1}_{b}")
+                in_planes = planes
+
+        feats = jnp.mean(x, axis=(1, 2, 3))  # global spatio-temporal average pool
+        logits = nn.Dense(self.num_classes, name="fc")(feats)
+        return feats, logits
+
+    def block_apply(self, x, planes, stride, downsample, name):
+        return BasicBlock(planes, stride, downsample, name=name)(x)
+
+
+def build(num_classes: int = 400) -> R2Plus1D:
+    return R2Plus1D(num_classes=num_classes)
+
+
+def init_params(seed: int = 0, num_classes: int = 400):
+    model = build(num_classes)
+    dummy = jnp.zeros((1, 4, 112, 112, 3), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy)["params"]

@@ -13,24 +13,13 @@ from typing import Dict
 import numpy as np
 
 from video_features_tpu.models.common.weights import (
+    bn_params as _bn,
     check_all_consumed,
     conv2d_kernel,
     strip_prefix,
     transpose_linear,
 )
 from video_features_tpu.models.resnet.model import ARCHS
-
-
-def _bn(sd: Dict[str, np.ndarray], prefix: str, consumed) -> Dict[str, np.ndarray]:
-    consumed.update(
-        f"{prefix}.{s}" for s in ("weight", "bias", "running_mean", "running_var")
-    )
-    return {
-        "scale": sd[f"{prefix}.weight"],
-        "bias": sd[f"{prefix}.bias"],
-        "mean": sd[f"{prefix}.running_mean"],
-        "var": sd[f"{prefix}.running_var"],
-    }
 
 
 def _conv(sd: Dict[str, np.ndarray], name: str, consumed) -> Dict[str, np.ndarray]:

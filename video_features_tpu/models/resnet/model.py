@@ -21,25 +21,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-
-class EvalBatchNorm(nn.Module):
-    """Inference-mode BatchNorm: running stats are plain params.
-
-    Folds to ``x * inv + shift`` where ``inv = scale / sqrt(var + eps)`` —
-    one fused multiply-add that XLA merges into the preceding conv.
-    """
-
-    eps: float = 1e-5
-
-    @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        C = x.shape[-1]
-        scale = self.param("scale", nn.initializers.ones, (C,))
-        bias = self.param("bias", nn.initializers.zeros, (C,))
-        mean = self.param("mean", nn.initializers.zeros, (C,))
-        var = self.param("var", nn.initializers.ones, (C,))
-        inv = scale * jax.lax.rsqrt(var + self.eps)
-        return x * inv + (bias - mean * inv)
+from video_features_tpu.models.common.layers import EvalBatchNorm
 
 
 def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
