@@ -125,15 +125,12 @@ class R2Plus1D(nn.Module):
             for b in range(n_blocks):
                 s = stride if b == 0 else 1
                 need_ds = s != 1 or in_planes != planes
-                x = self.block_apply(x, planes, s, need_ds, f"layer{stage + 1}_{b}")
+                x = BasicBlock(planes, s, need_ds, name=f"layer{stage + 1}_{b}")(x)
                 in_planes = planes
 
         feats = jnp.mean(x, axis=(1, 2, 3))  # global spatio-temporal average pool
         logits = nn.Dense(self.num_classes, name="fc")(feats)
         return feats, logits
-
-    def block_apply(self, x, planes, stride, downsample, name):
-        return BasicBlock(planes, stride, downsample, name=name)(x)
 
 
 def build(num_classes: int = 400) -> R2Plus1D:

@@ -1,0 +1,80 @@
+"""princeton-vl RAFT checkpoint (raft-sintel.pth / raft-kitti.pth) ->
+Flax param tree.
+
+The reference loads these through a degenerate single-device
+``torch.nn.DataParallel``, so every key carries a ``module.`` prefix
+(ref models/raft/extract_raft.py:59-61); stripped here. InstanceNorm
+layers (fnet, and every ``downsample.1``/``norm3`` of the fnet) carry no
+parameters — only the cnet's BatchNorms contribute stats.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    bn_params,
+    check_all_consumed,
+    conv2d_kernel,
+    strip_prefix,
+)
+
+
+def _conv(sd: Dict[str, np.ndarray], name: str, consumed) -> Dict[str, np.ndarray]:
+    consumed.update((f"{name}.weight", f"{name}.bias"))
+    return {"kernel": conv2d_kernel(sd[f"{name}.weight"]), "bias": sd[f"{name}.bias"]}
+
+
+def _encoder(sd: Dict[str, np.ndarray], enc: str, batch_norm: bool, consumed):
+    params = {
+        "conv1": _conv(sd, f"{enc}.conv1", consumed),
+        "conv2": _conv(sd, f"{enc}.conv2", consumed),
+    }
+    if batch_norm:
+        params["norm1"] = bn_params(sd, f"{enc}.norm1", consumed)
+    for layer in (1, 2, 3):
+        for b in (0, 1):
+            ref = f"{enc}.layer{layer}.{b}"
+            blk = {
+                "conv1": _conv(sd, f"{ref}.conv1", consumed),
+                "conv2": _conv(sd, f"{ref}.conv2", consumed),
+            }
+            if batch_norm:
+                blk["norm1"] = bn_params(sd, f"{ref}.norm1", consumed)
+                blk["norm2"] = bn_params(sd, f"{ref}.norm2", consumed)
+            if f"{ref}.downsample.0.weight" in sd:
+                blk["downsample"] = _conv(sd, f"{ref}.downsample.0", consumed)
+                if batch_norm:
+                    blk["norm3"] = bn_params(sd, f"{ref}.downsample.1", consumed)
+            params[f"layer{layer}_{b}"] = blk
+    return params
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray]):
+    sd = strip_prefix(sd, "module.")
+    consumed = set()
+    update = {
+        "encoder": {
+            name: _conv(sd, f"update_block.encoder.{name}", consumed)
+            for name in ("convc1", "convc2", "convf1", "convf2", "conv")
+        },
+        "gru": {
+            name: _conv(sd, f"update_block.gru.{name}", consumed)
+            for name in ("convz1", "convr1", "convq1", "convz2", "convr2", "convq2")
+        },
+        "flow_head": {
+            name: _conv(sd, f"update_block.flow_head.{name}", consumed)
+            for name in ("conv1", "conv2")
+        },
+        "mask_0": _conv(sd, "update_block.mask.0", consumed),
+        "mask_2": _conv(sd, "update_block.mask.2", consumed),
+    }
+    params = {
+        "fnet": _encoder(sd, "fnet", batch_norm=False, consumed=consumed),
+        "cnet": _encoder(sd, "cnet", batch_norm=True, consumed=consumed),
+        "update_block": update,
+    }
+    check_all_consumed(sd, consumed, "RAFT")
+    return params
