@@ -1,0 +1,139 @@
+"""Shared runtime for pairwise optical-flow extractors (RAFT, PWC).
+
+Both reference extractors run the identical loop — streaming decode,
+optional ``--side_size`` PIL resize, raw [0,255] float frames, batches of
+B+1 frames sharing one boundary frame so B flow pairs come out per call
+(ref models/raft/extract_raft.py:93-146, models/pwc/extract_pwc.py:93-144)
+— and differ only in the model and RAFT's /8 replicate padding.
+
+TPU-first: every batch runs at ONE static shape — the tail batch is
+filled by repeating the last frame and the surplus pair outputs dropped —
+so XLA compiles a single executable per video resolution.
+
+Output contract: ``{<type>: (T-1, 2, H, W), fps, timestamps_ms}``
+(ref extract_raft.py:155-160), flow at input resolution.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import probe, stream_frames
+from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.ops.preprocess import pil_resize
+
+
+class NullPadder:
+    """PWC needs no host-side padding — the /64 resize lives in-model."""
+
+    def pad(self, x: np.ndarray) -> np.ndarray:
+        return x
+
+    def unpad(self, x: np.ndarray) -> np.ndarray:
+        return x
+
+
+class PairwiseFlowExtractor(BaseExtractor):
+    """Subclasses provide ``_model()``, ``_convert_state_dict`` and
+    optionally ``_make_padder(shape)``."""
+
+    def __init__(self, config, external_call: bool = False) -> None:
+        super().__init__(config, external_call)
+        self.batch_size = max(int(self.config.batch_size or 1), 1)
+        self.side_size = self.config.side_size
+        self.resize_to_smaller_edge = self.config.resize_to_smaller_edge
+        self._host_params = None
+
+    # --- model hooks -------------------------------------------------------
+    def _model(self):
+        raise NotImplementedError
+
+    def _init_params(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def _convert_state_dict(sd):
+        raise NotImplementedError("subclass must set _convert_state_dict")
+
+    def _make_padder(self, shape):
+        return NullPadder()
+
+    # --- runtime -----------------------------------------------------------
+    def _load_host_params(self):
+        if self._host_params is None:
+            if self.config.weights_path:
+                self._host_params = load_params(
+                    self.config.weights_path, type(self)._convert_state_dict
+                )
+            else:
+                self._host_params = self._init_params()
+        return self._host_params
+
+    def _build(self, device):
+        model = self._model()
+        params = jax.device_put(self._load_host_params(), device)
+
+        @jax.jit
+        def forward(p, frames):  # (B+1, H, W, 3) -> (B, H, W, 2)
+            return model.apply({"params": p}, frames)
+
+        return {"params": params, "forward": forward, "device": device}
+
+    def _preprocess(self, frame: np.ndarray) -> np.ndarray:
+        if self.side_size is not None:
+            frame = pil_resize(frame, int(self.side_size), self.resize_to_smaller_edge)
+        return frame.astype(np.float32)
+
+    def _run_batch(
+        self, state, batch: List[np.ndarray], padder, flows: List[np.ndarray]
+    ) -> None:
+        n_pairs = len(batch) - 1
+        if n_pairs < 1:
+            return
+        window = batch + [batch[-1]] * (self.batch_size + 1 - len(batch))
+        x = padder.pad(np.stack(window))
+        x = jax.device_put(jnp.asarray(x), state["device"])
+        flow = np.asarray(state["forward"](state["params"], x))  # (B, Hp, Wp, 2)
+        flow = padder.unpad(flow)[:n_pairs]
+        flows.extend(np.transpose(flow, (0, 3, 1, 2)))  # saved as (2, H, W)
+        if self.config.show_pred:
+            from video_features_tpu.utils.flow_viz import show_flow_on_frame
+
+            for i in range(n_pairs):
+                show_flow_on_frame(flow[i], batch[i])
+
+    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+        video_path = video_path_of(path_entry)
+        fps = self.config.extraction_fps or probe(video_path).fps or 25.0
+
+        flows: List[np.ndarray] = []
+        timestamps_ms: List[float] = []
+        batch: List[np.ndarray] = []
+        padder = None
+        for frame, ts in stream_frames(video_path, self.config.extraction_fps):
+            timestamps_ms.append(ts)
+            frame = self._preprocess(frame)
+            if padder is None:
+                padder = self._make_padder(frame.shape[:2])
+            batch.append(frame)
+            # B+1 frames make B pairs; the boundary frame carries over
+            if len(batch) - 1 == self.batch_size:
+                self._run_batch(state, batch, padder, flows)
+                batch = [batch[-1]]
+        if len(batch) > 1:
+            self._run_batch(state, batch, padder, flows)
+        if padder is None:
+            raise IOError(f"no frames decoded from {video_path}")
+
+        return {
+            self.feature_type: np.array(flows),
+            "fps": np.array(fps),
+            "timestamps_ms": np.array(timestamps_ms),
+        }

@@ -1,0 +1,66 @@
+"""sniklaus pytorch-pwc checkpoint (pwc_net_sintel.pt) -> Flax param tree.
+
+torch naming (ref pwc_src/pwc_net.py): ``moduleExtractor.module{One..Six}``
+Sequentials (conv indices 0/2/4), top-level ``module{Two..Six}`` decoders
+with ``moduleUpflow``/``moduleUpfeat`` ConvTranspose2d + ``moduleOne.0``
+.. ``moduleSix.0`` convs, and ``moduleRefiner.moduleMain`` (indices
+0,2,...,12). ConvTranspose kernels are pre-flipped into HWIO so the model
+applies them as input-dilated regular convs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    check_all_consumed,
+    conv2d_kernel,
+    strip_prefix,
+)
+
+_ORDINAL = {1: "One", 2: "Two", 3: "Thr", 4: "Fou", 5: "Fiv", 6: "Six"}
+
+
+def _conv(sd: Dict[str, np.ndarray], name: str, consumed) -> Dict[str, np.ndarray]:
+    consumed.update((f"{name}.weight", f"{name}.bias"))
+    return {"kernel": conv2d_kernel(sd[f"{name}.weight"]), "bias": sd[f"{name}.bias"]}
+
+
+def _conv_transpose(sd: Dict[str, np.ndarray], name: str, consumed):
+    """torch ConvTranspose2d weight (I, O, kH, kW) -> spatially flipped
+    HWIO kernel for the equivalent input-dilated regular conv."""
+    consumed.update((f"{name}.weight", f"{name}.bias"))
+    w = np.transpose(sd[f"{name}.weight"], (2, 3, 0, 1))[::-1, ::-1]
+    return {"kernel": np.ascontiguousarray(w), "bias": sd[f"{name}.bias"]}
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray]):
+    sd = strip_prefix(sd, "module.")
+    consumed = set()
+
+    extractor = {}
+    for lvl in range(1, 7):
+        seq = f"moduleExtractor.module{_ORDINAL[lvl]}"
+        for i, idx in enumerate((0, 2, 4)):
+            extractor[f"lvl{lvl}_conv{i}"] = _conv(sd, f"{seq}.{idx}", consumed)
+
+    params = {"extractor": extractor}
+    for lvl in range(2, 7):
+        dec = f"module{_ORDINAL[lvl]}"
+        blk = {}
+        if lvl < 6:
+            blk["upflow"] = _conv_transpose(sd, f"{dec}.moduleUpflow", consumed)
+            blk["upfeat"] = _conv_transpose(sd, f"{dec}.moduleUpfeat", consumed)
+        for i in range(5):
+            blk[f"conv{i}"] = _conv(sd, f"{dec}.module{_ORDINAL[i + 1]}.0", consumed)
+        blk["flow"] = _conv(sd, f"{dec}.moduleSix.0", consumed)
+        params[f"decoder{lvl}"] = blk
+
+    params["refiner"] = {
+        f"conv{i}": _conv(sd, f"moduleRefiner.moduleMain.{idx}", consumed)
+        for i, idx in enumerate((0, 2, 4, 6, 8, 10, 12))
+    }
+    check_all_consumed(sd, consumed, "PWCNet")
+    return params

@@ -1,0 +1,22 @@
+"""PWC-Net optical-flow extractor (ref models/pwc/extract_pwc.py).
+
+Same pair-streaming runtime as RAFT (shared PairwiseFlowExtractor); no
+host-side padding — the /64-multiple resize is part of the PWC forward
+(ref pwc_src/pwc_net.py:241-245). Flow comes back at input resolution.
+"""
+
+from __future__ import annotations
+
+from video_features_tpu.models.common.flow_extract import PairwiseFlowExtractor
+from video_features_tpu.models.pwc.convert import convert_state_dict
+from video_features_tpu.models.pwc.model import build, init_params
+
+
+class ExtractPWC(PairwiseFlowExtractor):
+    _convert_state_dict = staticmethod(convert_state_dict)
+
+    def _model(self):
+        return build()
+
+    def _init_params(self):
+        return init_params()

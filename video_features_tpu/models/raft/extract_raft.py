@@ -1,36 +1,19 @@
 """RAFT optical-flow extractor (ref models/raft/extract_raft.py).
 
-Per video: streaming decode (optionally on an ``--extraction_fps`` grid),
-optional ``--side_size`` PIL resize (smaller or larger edge, ref
-transforms ResizeImproved), frames kept as raw [0,255] floats, replicate-
-padded to /8 multiples (InputPadder 'sintel' mode, ref
-raft_src/raft.py:28-44), batched as B+1 frames sharing one boundary frame
-between consecutive batches (ref extract_raft.py:139-146).
-
-TPU-first: every batch runs at ONE static shape — the tail batch is
-filled by repeating the last frame and the extra pair outputs are
-discarded — so XLA compiles a single executable per video resolution.
-
-Output contract: ``{raft: (T-1, 2, H, W), fps, timestamps_ms}``
-(ref extract_raft.py:155-160), flow at unpadded input resolution.
+Pair-streaming runtime shared with PWC (PairwiseFlowExtractor); RAFT adds
+replicate padding to /8 multiples (InputPadder 'sintel' mode, ref
+raft_src/raft.py:28-44) before the model and unpads the flow after.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Tuple
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
-from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.paths import video_path_of
-from video_features_tpu.io.video import probe, stream_frames
-from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.common.flow_extract import PairwiseFlowExtractor
 from video_features_tpu.models.raft.convert import convert_state_dict
 from video_features_tpu.models.raft.model import build, init_params
-from video_features_tpu.ops.preprocess import pil_resize
 
 
 class InputPadder:
@@ -64,84 +47,14 @@ class InputPadder:
         return x[..., t : H - b, l : W - r, :]
 
 
-class ExtractRAFT(BaseExtractor):
-    def __init__(self, config, external_call: bool = False) -> None:
-        super().__init__(config, external_call)
-        self.batch_size = max(int(self.config.batch_size or 1), 1)
-        self.side_size = self.config.side_size
-        self.resize_to_smaller_edge = self.config.resize_to_smaller_edge
-        self._host_params = None
+class ExtractRAFT(PairwiseFlowExtractor):
+    _convert_state_dict = staticmethod(convert_state_dict)
 
-    def _load_host_params(self):
-        if self._host_params is None:
-            if self.config.weights_path:
-                self._host_params = load_params(
-                    self.config.weights_path, convert_state_dict
-                )
-            else:
-                self._host_params = init_params()
-        return self._host_params
+    def _model(self):
+        return build()
 
-    def _build(self, device):
-        model = build()
-        params = jax.device_put(self._load_host_params(), device)
+    def _init_params(self):
+        return init_params()
 
-        @jax.jit
-        def forward(p, frames):  # (B+1, H, W, 3) -> (B, H, W, 2)
-            return model.apply({"params": p}, frames)
-
-        return {"params": params, "forward": forward, "device": device}
-
-    def _preprocess(self, frame: np.ndarray) -> np.ndarray:
-        if self.side_size is not None:
-            frame = pil_resize(frame, int(self.side_size), self.resize_to_smaller_edge)
-        return frame.astype(np.float32)
-
-    def _run_batch(
-        self, state, batch: List[np.ndarray], padder: InputPadder, flows: List[np.ndarray]
-    ) -> None:
-        """Run flow on a B+1 frame window; tail windows are filled by
-        repeating the last frame and the surplus pairs dropped."""
-        n_pairs = len(batch) - 1
-        if n_pairs < 1:
-            return
-        window = batch + [batch[-1]] * (self.batch_size + 1 - len(batch))
-        x = padder.pad(np.stack(window))
-        x = jax.device_put(jnp.asarray(x), state["device"])
-        flow = np.asarray(state["forward"](state["params"], x))  # (B, Hp, Wp, 2)
-        flow = padder.unpad(flow)[:n_pairs]
-        flows.extend(np.transpose(flow, (0, 3, 1, 2)))  # saved as (2, H, W)
-        if self.config.show_pred:
-            from video_features_tpu.utils.flow_viz import show_flow_on_frame
-
-            for i in range(n_pairs):
-                show_flow_on_frame(flow[i], batch[i])
-
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
-        video_path = video_path_of(path_entry)
-        fps = self.config.extraction_fps or probe(video_path).fps or 25.0
-
-        flows: List[np.ndarray] = []
-        timestamps_ms: List[float] = []
-        batch: List[np.ndarray] = []
-        padder = None
-        for frame, ts in stream_frames(video_path, self.config.extraction_fps):
-            timestamps_ms.append(ts)
-            frame = self._preprocess(frame)
-            if padder is None:
-                padder = InputPadder(frame.shape[:2])
-            batch.append(frame)
-            # B+1 frames make B pairs; the boundary frame carries over
-            if len(batch) - 1 == self.batch_size:
-                self._run_batch(state, batch, padder, flows)
-                batch = [batch[-1]]
-        if len(batch) > 1:
-            self._run_batch(state, batch, padder, flows)
-        if padder is None:
-            raise IOError(f"no frames decoded from {video_path}")
-
-        return {
-            self.feature_type: np.array(flows),
-            "fps": np.array(fps),
-            "timestamps_ms": np.array(timestamps_ms),
-        }
+    def _make_padder(self, shape):
+        return InputPadder(shape)
