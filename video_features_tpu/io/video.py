@@ -57,6 +57,29 @@ def probe(path: str) -> VideoMeta:
     return meta
 
 
+def read_frames_at_indices(path: str, indices) -> dict:
+    """Sequential decode returning {index: rgb_uint8_hwc} for the wanted
+    frame indices; indices past the decodable end are simply absent."""
+    need = set(int(i) for i in indices)
+    if not need:
+        return {}
+    got = {}
+    cap = cv2.VideoCapture(str(path))
+    try:
+        last = max(need)
+        i = 0
+        while i <= last:
+            ok, frame = cap.read()
+            if not ok:
+                break
+            if i in need:
+                got[i] = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+            i += 1
+    finally:
+        cap.release()
+    return got
+
+
 def stream_frames(
     path: str,
     extraction_fps: Optional[float] = None,

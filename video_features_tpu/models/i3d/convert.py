@@ -1,0 +1,62 @@
+"""I3D checkpoint (i3d_rgb.pt / i3d_flow.pt) -> Flax param tree.
+
+torch naming (ref i3d_src/i3d_net.py): ``conv3d_*.conv3d.weight`` +
+``conv3d_*.batch3d.*``, ``mixed_*.branch_0.*``, ``mixed_*.branch_{1,2}.
+{0,1}.*`` (Sequential), ``mixed_*.branch_3.1.*`` (index 0 is the pool),
+``conv3d_0c_1x1.conv3d.{weight,bias}``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    bn_params,
+    check_all_consumed,
+    conv3d_kernel,
+    strip_prefix,
+)
+
+_MIXED = (
+    "mixed_3b", "mixed_3c",
+    "mixed_4b", "mixed_4c", "mixed_4d", "mixed_4e", "mixed_4f",
+    "mixed_5b", "mixed_5c",
+)
+_STEM = ("conv3d_1a_7x7", "conv3d_2b_1x1", "conv3d_2c_3x3")
+# flax branch name -> torch branch prefix
+_BRANCHES = {
+    "branch_0": "branch_0",
+    "branch_1_0": "branch_1.0",
+    "branch_1_1": "branch_1.1",
+    "branch_2_0": "branch_2.0",
+    "branch_2_1": "branch_2.1",
+    "branch_3_1": "branch_3.1",
+}
+
+
+def _unit(sd: Dict[str, np.ndarray], prefix: str, consumed, bias: bool = False):
+    consumed.add(f"{prefix}.conv3d.weight")
+    conv = {"kernel": conv3d_kernel(sd[f"{prefix}.conv3d.weight"])}
+    if bias:
+        consumed.add(f"{prefix}.conv3d.bias")
+        conv["bias"] = sd[f"{prefix}.conv3d.bias"]
+    unit = {"conv3d": conv}
+    if f"{prefix}.batch3d.weight" in sd:
+        unit["batch3d"] = bn_params(sd, f"{prefix}.batch3d", consumed)
+    return unit
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray]):
+    sd = strip_prefix(sd, "module.")
+    consumed = set()
+    params = {name: _unit(sd, name, consumed) for name in _STEM}
+    for mixed in _MIXED:
+        for flax_name, torch_name in _BRANCHES.items():
+            params.setdefault(mixed, {})[flax_name] = _unit(
+                sd, f"{mixed}.{torch_name}", consumed
+            )
+    params["conv3d_0c_1x1"] = _unit(sd, "conv3d_0c_1x1", consumed, bias=True)
+    check_all_consumed(sd, consumed, "I3D")
+    return params

@@ -1,0 +1,300 @@
+"""I3D two-stream extractor (ref models/i3d/extract_i3d.py) — the
+deepest pipeline: RGB + optical-flow Kinetics features over sliding
+64-frame stacks, with flow computed on the fly by RAFT or PWC, or read
+from pre-extracted flow JPEGs (``--flow_type flow`` + ``--flow_dir``).
+
+Per video (ref extract_i3d.py:239-297): frames sampled on the reference's
+grid — ``--extraction_fps`` linspace, or upsampling-to-65 for short
+videos (against the DEFAULT stack of 64, a reference quirk kept even when
+``--stack_size`` differs), or all frames — resized min-side 256, windowed
+into stack_size+1 frame stacks sliding by step_size (ragged tail
+dropped). Each stream runs as ONE jitted pipeline per video resolution:
+flow model (RAFT on /8-replicate-padded stacks, flow kept at padded res
+exactly like the reference, ref extract_i3d.py:170-173) -> center-crop
+224 -> clamp[-20,20] -> uint8 quantize -> [-1,1] -> I3D; RGB ->
+center-crop 224 -> [-1,1] -> I3D.
+
+Weights: ``--weights_path`` points to a DIRECTORY holding any of
+``i3d_rgb.pt``, ``i3d_flow.pt``, ``raft-sintel.pth``, ``pwc_net_sintel.pt``
+(the reference hardcodes these names, ref extract_i3d.py:23-26); missing
+files fall back to deterministic random init.
+
+Output contract: ``{rgb: (S, 1024), flow: (S, 1024), fps, timestamps_ms}``
+(ref extract_i3d.py:299-303). Divergence: the reference computes
+timestamps with ``0.001/fps`` (claiming ms, off by 1e6,
+ref extract_i3d.py:242); here they are real milliseconds.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import cv2
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.video import probe, read_frames_at_indices
+from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.i3d.convert import convert_state_dict as i3d_convert
+from video_features_tpu.models.i3d.model import build as i3d_build
+from video_features_tpu.models.i3d.model import init_params as i3d_init
+from video_features_tpu.ops.preprocess import flow_to_uint8, pil_resize, scale_to_1_1
+from video_features_tpu.utils.labels import show_predictions_on_dataset
+
+MIN_SIDE_SIZE = 256
+CENTRAL_CROP_SIZE = 224
+DEFAULT_STACK_SIZE = 64
+DEFAULT_STEP_SIZE = 64
+
+# checkpoint file names searched under --weights_path (a directory),
+# mirroring the reference's hardcoded paths (ref extract_i3d.py:23-26)
+WEIGHT_FILES = {
+    "rgb": "i3d_rgb.pt",
+    "flow": "i3d_flow.pt",
+    "raft": "raft-sintel.pth",
+    "pwc": "pwc_net_sintel.pt",
+}
+
+
+def center_crop(x: jnp.ndarray, crop: int = CENTRAL_CROP_SIZE) -> jnp.ndarray:
+    """(..., H, W, C) tensor-space center crop (ref transforms.py:7-18)."""
+    H, W = x.shape[-3], x.shape[-2]
+    fh = (H - crop) // 2
+    fw = (W - crop) // 2
+    return x[..., fh : fh + crop, fw : fw + crop, :]
+
+
+class ExtractI3D(BaseExtractor):
+    def __init__(self, config, external_call: bool = False) -> None:
+        super().__init__(config, external_call)
+        self.streams = list(self.config.streams or ["rgb", "flow"])
+        self.flow_type = self.config.flow_type or "pwc"
+        self.stack_size = int(self.config.stack_size or DEFAULT_STACK_SIZE)
+        self.step_size = int(self.config.step_size or DEFAULT_STEP_SIZE)
+        self._host_params: Dict[str, object] = {}
+
+    # --- weights -----------------------------------------------------------
+    def _weights_file(self, kind: str):
+        root = self.config.weights_path
+        if root is None:
+            return None
+        if not os.path.isdir(root):
+            raise ValueError(
+                "i3d needs several checkpoints; --weights_path must be a "
+                f"DIRECTORY containing any of {sorted(WEIGHT_FILES.values())} "
+                f"(got file: {root})"
+            )
+        path = os.path.join(root, WEIGHT_FILES[kind])
+        return path if os.path.exists(path) else None
+
+    def _params(self, kind: str):
+        if kind not in self._host_params:
+            path = self._weights_file(kind)
+            if kind in ("rgb", "flow"):
+                self._host_params[kind] = (
+                    load_params(path, i3d_convert) if path else i3d_init(kind)
+                )
+            elif kind == "raft":
+                from video_features_tpu.models.raft.convert import (
+                    convert_state_dict as raft_convert,
+                )
+                from video_features_tpu.models.raft.model import (
+                    init_params as raft_init,
+                )
+
+                self._host_params[kind] = (
+                    load_params(path, raft_convert) if path else raft_init()
+                )
+            else:  # pwc
+                from video_features_tpu.models.pwc.convert import (
+                    convert_state_dict as pwc_convert,
+                )
+                from video_features_tpu.models.pwc.model import (
+                    init_params as pwc_init,
+                )
+
+                self._host_params[kind] = (
+                    load_params(path, pwc_convert) if path else pwc_init()
+                )
+        return self._host_params[kind]
+
+    # --- per-device state --------------------------------------------------
+    def _build(self, device):
+        state = {"device": device, "params": {}, "fns": {}}
+        for stream in self.streams:
+            state["params"][stream] = jax.device_put(self._params(stream), device)
+        if "flow" in self.streams and self.flow_type in ("raft", "pwc"):
+            state["params"][self.flow_type] = jax.device_put(
+                self._params(self.flow_type), device
+            )
+        return state
+
+    def _fns_for_shape(self, state, shape):
+        """Jitted per-stream pipelines for one (H, W) frame shape."""
+        key = tuple(shape)
+        if key in state["fns"]:
+            return state["fns"][key]
+        i3d = i3d_build()
+        fns = {}
+
+        if "rgb" in self.streams:
+
+            @jax.jit
+            def rgb_fn(p, stack):  # (S+1, H, W, 3) raw [0,255] floats
+                # stack[:-1] in EVERY mode: with pre-extracted flow the
+                # window is stack_size, so rgb runs on stack_size-1 frames
+                # — exactly the reference (extract_i3d.py:178-179,221-222)
+                x = scale_to_1_1(center_crop(stack[:-1]))
+                return i3d.apply({"params": p}, x[None])
+
+            fns["rgb"] = rgb_fn
+
+        if "flow" in self.streams and self.flow_type == "raft":
+            from video_features_tpu.models.raft.extract_raft import InputPadder
+            from video_features_tpu.models.raft.model import build as raft_build
+
+            raft = raft_build()
+            padder = InputPadder(shape)
+            l, r, t, b = padder._pad
+
+            @jax.jit
+            def flow_fn(p_flow, p_i3d, stack):
+                padded = jnp.pad(
+                    stack, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge"
+                )
+                flow = raft.apply({"params": p_flow}, padded)  # (S, Hp, Wp, 2)
+                # the reference crops the PADDED flow (extract_i3d.py:170-184)
+                f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
+                return i3d.apply({"params": p_i3d}, f[None])
+
+            fns["flow"] = flow_fn
+        elif "flow" in self.streams and self.flow_type == "pwc":
+            from video_features_tpu.models.pwc.model import build as pwc_build
+
+            pwc = pwc_build()
+
+            @jax.jit
+            def flow_fn(p_flow, p_i3d, stack):
+                flow = pwc.apply({"params": p_flow}, stack)  # (S, H, W, 2)
+                f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
+                return i3d.apply({"params": p_i3d}, f[None])
+
+            fns["flow"] = flow_fn
+        elif "flow" in self.streams and self.flow_type == "flow":
+
+            @jax.jit
+            def flow_fn(p_i3d, flow_imgs):  # (S, H', W', 2) uint8 as floats
+                # the reference runs flow JPEGs through the SAME transform
+                # chain as live flow, clamp included (extract_i3d.py:195-229)
+                f = scale_to_1_1(flow_to_uint8(center_crop(flow_imgs)))
+                return i3d.apply({"params": p_i3d}, f[None])
+
+            fns["flow"] = flow_fn
+
+        state["fns"][key] = fns
+        return fns
+
+    # --- decode ------------------------------------------------------------
+    def _sample_frames(self, video_path: str):
+        """The reference's I3D-specific sampling grid
+        (ref extract_i3d.py:239-259): fps-linspace / short-video
+        upsample-to-65 / all frames. Returns (frames, fps, timestamps_ms)."""
+        meta = probe(video_path)
+        fps = meta.fps or 25.0
+        frame_cnt = meta.frame_count
+        mspf = 1000.0 / fps
+        if self.config.extraction_fps is not None:
+            samples_num = max(int(frame_cnt / fps * self.config.extraction_fps), 1)
+            samples_ix = np.linspace(1, max(frame_cnt - 1, 1), samples_num).astype(int)
+        elif frame_cnt < DEFAULT_STACK_SIZE + 1:
+            samples_num = DEFAULT_STACK_SIZE + 1
+            samples_ix = np.linspace(1, max(frame_cnt - 1, 1), samples_num).astype(int)
+        else:
+            samples_ix = np.arange(frame_cnt)
+
+        wanted = read_frames_at_indices(video_path, samples_ix)
+        # undecodable sampled indices are dropped, exactly like the
+        # reference's `if i is not None` filter (ref extract_i3d.py:245-257)
+        frames = [wanted[i] for i in samples_ix if i in wanted]
+        stamps = [i * mspf for i in samples_ix if i in wanted]
+        return frames, fps, stamps
+
+    def _load_flow_pairs(self, flow_dir: str):
+        """Sorted flow_x_*/flow_y_* JPEG pairs (ref extract_i3d.py:231-237)."""
+        import pathlib
+
+        xs = sorted(pathlib.Path(flow_dir).glob("flow_x*.jpg"), key=lambda p: p.stem[7:])
+        ys = sorted(pathlib.Path(flow_dir).glob("flow_y*.jpg"), key=lambda p: p.stem[7:])
+        return list(zip(xs, ys))
+
+    # --- main --------------------------------------------------------------
+    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+        from_disk = self.flow_type == "flow"
+        if from_disk:
+            video_path, flow_dir = path_entry
+            flows = self._load_flow_pairs(flow_dir)
+        else:
+            video_path = path_entry
+        frames, fps, timestamps_ms = self._sample_frames(video_path)
+        if not frames:
+            raise IOError(f"no frames decoded from {video_path}")
+        frames = [
+            pil_resize(f, MIN_SIDE_SIZE).astype(np.float32) for f in frames
+        ]
+        fns = self._fns_for_shape(state, frames[0].shape[:2])
+
+        feats: Dict[str, List[np.ndarray]] = {s: [] for s in self.streams}
+        window = self.stack_size + (0 if from_disk else 1)
+        stack_counter = 0
+        start = 0
+        while start + window <= len(frames):
+            stack = np.stack(frames[start : start + window])
+            x = jax.device_put(jnp.asarray(stack), state["device"])
+            for stream in self.streams:
+                if stream == "rgb":
+                    f, logits = fns["rgb"](state["params"]["rgb"], x)
+                elif from_disk:
+                    pair_slice = flows[start : start + window]
+                    imgs = np.stack(
+                        [
+                            np.stack(
+                                [
+                                    cv2.imread(str(fx), cv2.IMREAD_GRAYSCALE),
+                                    cv2.imread(str(fy), cv2.IMREAD_GRAYSCALE),
+                                ],
+                                axis=-1,
+                            )
+                            for fx, fy in pair_slice
+                        ]
+                    ).astype(np.float32)
+                    if min(imgs.shape[1:3]) < CENTRAL_CROP_SIZE:
+                        raise ValueError(
+                            f"flow images {imgs.shape[1:3]} are smaller than "
+                            f"the {CENTRAL_CROP_SIZE}px center crop"
+                        )
+                    f, logits = fns["flow"](
+                        state["params"]["flow"],
+                        jax.device_put(jnp.asarray(imgs), state["device"]),
+                    )
+                else:
+                    f, logits = fns["flow"](
+                        state["params"][self.flow_type], state["params"]["flow"], x
+                    )
+                feats[stream].append(np.asarray(f)[0])
+                if self.config.show_pred:
+                    print(f"{video_path} @ stack {stack_counter} ({stream} stream)")
+                    show_predictions_on_dataset(np.asarray(logits)[0], "kinetics")
+            start += self.step_size
+            stack_counter += 1
+
+        out: Dict[str, np.ndarray] = {
+            s: np.array(feats[s], dtype=np.float32).reshape(-1, 1024)
+            for s in self.streams
+        }
+        out["fps"] = np.array(fps)
+        out["timestamps_ms"] = np.array(timestamps_ms)
+        return out

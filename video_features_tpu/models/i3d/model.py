@@ -1,0 +1,154 @@
+"""I3D (Inception-3D) in Flax (inference graph).
+
+Reference: models/i3d/i3d_src/i3d_net.py — the Kinetics-400 two-stream
+I3D with TF-style SAME padding. The padding is the subtle part
+(SURVEY.md §7 hard part #4): every conv/pool pads asymmetrically with
+``pad_along = max(kernel - stride, 0)``, low side ``pad_along // 2``
+(ref i3d_net.py:8-25), which differs from both torch's symmetric padding
+and XLA's input-size-aware 'SAME'. Max pools zero-pad explicitly and run
+ceil-mode (ref i3d_net.py:108-120) — after ReLU everything is >= 0, so
+reduce_window's -inf fill with an extra (stride-1) high-side pad
+reproduces both the zero fill and the ceil semantics.
+
+NDHWC layout end-to-end; inference BatchNorm folded to multiply-add;
+forward returns (features (B, 1024), logits (B, num_classes)) in one
+pass — the pre-logit time-averaged features of ``features=True`` plus
+the classifier head used by ``--show_pred`` (ref i3d_net.py:238-274).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from video_features_tpu.models.common.layers import EvalBatchNorm
+
+I3D_FEATURE_DIM = 1024
+I3D_NUM_CLASSES = 400
+
+
+def tf_same_pads(kernel: Sequence[int], stride: Sequence[int]):
+    """(lo, hi) per spatial dim: ``pad_along = max(k - s, 0)`` split with
+    the smaller half first (ref i3d_net.py:8-25)."""
+    pads = []
+    for k, s in zip(kernel, stride):
+        along = max(k - s, 0)
+        pads.append((along // 2, along - along // 2))
+    return pads
+
+
+class Unit3D(nn.Module):
+    """Conv3d + BN + ReLU with TF SAME padding (ref i3d_net.py:37-105)."""
+
+    features: int
+    kernel: Tuple[int, int, int] = (1, 1, 1)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    use_bn: bool = True
+    use_bias: bool = False
+    activation: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.stride,
+            padding=tf_same_pads(self.kernel, self.stride),
+            use_bias=self.use_bias,
+            name="conv3d",
+        )(x)
+        if self.use_bn:
+            x = EvalBatchNorm(name="batch3d")(x)
+        if self.activation:
+            x = nn.relu(x)
+        return x
+
+
+def max_pool_tf(x: jnp.ndarray, kernel, stride) -> jnp.ndarray:
+    """TF-SAME zero-padded, ceil-mode 3D max pool (ref i3d_net.py:108-120).
+
+    reduce_window fills with -inf; valid since inputs are post-ReLU, and
+    the extra (stride-1) high-side pad turns floor sizing into ceil."""
+    pads = [
+        (lo, hi + s - 1)
+        for (lo, hi), s in zip(tf_same_pads(kernel, stride), stride)
+    ]
+    return nn.max_pool(
+        x, tuple(kernel), strides=tuple(stride), padding=pads
+    )
+
+
+class Mixed(nn.Module):
+    """Inception block: 1x1 / 1x1->3x3 / 1x1->3x3 / pool->1x1 branches
+    (ref i3d_net.py:123-157)."""
+
+    out: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        o = self.out
+        b0 = Unit3D(o[0], name="branch_0")(x)
+        b1 = Unit3D(o[2], (3, 3, 3), name="branch_1_1")(
+            Unit3D(o[1], name="branch_1_0")(x)
+        )
+        b2 = Unit3D(o[4], (3, 3, 3), name="branch_2_1")(
+            Unit3D(o[3], name="branch_2_0")(x)
+        )
+        b3 = Unit3D(o[5], name="branch_3_1")(
+            max_pool_tf(x, (3, 3, 3), (1, 1, 1))
+        )
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class I3D(nn.Module):
+    """(B, T, H, W, C) in [-1, 1] (C=3 rgb / 2 flow) ->
+    (features (B, 1024), logits (B, num_classes))."""
+
+    num_classes: int = I3D_NUM_CLASSES
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = Unit3D(64, (7, 7, 7), (2, 2, 2), name="conv3d_1a_7x7")(x)
+        x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
+        x = Unit3D(64, name="conv3d_2b_1x1")(x)
+        x = Unit3D(192, (3, 3, 3), name="conv3d_2c_3x3")(x)
+        x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
+        x = Mixed([64, 96, 128, 16, 32, 32], name="mixed_3b")(x)
+        x = Mixed([128, 128, 192, 32, 96, 64], name="mixed_3c")(x)
+        x = max_pool_tf(x, (3, 3, 3), (2, 2, 2))
+        x = Mixed([192, 96, 208, 16, 48, 64], name="mixed_4b")(x)
+        x = Mixed([160, 112, 224, 24, 64, 64], name="mixed_4c")(x)
+        x = Mixed([128, 128, 256, 24, 64, 64], name="mixed_4d")(x)
+        x = Mixed([112, 144, 288, 32, 64, 64], name="mixed_4e")(x)
+        x = Mixed([256, 160, 320, 32, 128, 128], name="mixed_4f")(x)
+        x = max_pool_tf(x, (2, 2, 2), (2, 2, 2))
+        x = Mixed([256, 160, 320, 32, 128, 128], name="mixed_5b")(x)
+        x = Mixed([384, 192, 384, 48, 128, 128], name="mixed_5c")(x)
+
+        # AvgPool3d((2, 7, 7), stride 1), VALID (ref i3d_net.py:227)
+        x = nn.avg_pool(x, (2, 7, 7), strides=(1, 1, 1))  # (B, T', 1, 1, 1024)
+        feats = jnp.mean(x, axis=(1, 2, 3))  # time-avg -> (B, 1024)
+
+        logits = Unit3D(
+            self.num_classes,
+            use_bn=False,
+            use_bias=True,
+            activation=False,
+            name="conv3d_0c_1x1",
+        )(x)
+        logits = jnp.mean(logits, axis=(1, 2, 3))  # (B, num_classes)
+        return feats, logits
+
+
+def build(num_classes: int = I3D_NUM_CLASSES) -> I3D:
+    return I3D(num_classes=num_classes)
+
+
+def init_params(modality: str, seed: int = 0, num_classes: int = I3D_NUM_CLASSES):
+    model = build(num_classes)
+    in_ch = {"rgb": 3, "flow": 2}[modality]
+    dummy = jnp.zeros((1, 10, 224, 224, in_ch), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy)["params"]
