@@ -1,0 +1,157 @@
+"""VGGish: log-mel frontend properties, VGG parity vs a torch oracle,
+postprocessor math, end-to-end wav extraction.
+
+The net oracle is a torch VGG with torchvggish state-dict names
+(features.{0,3,6,8,11,13}, embeddings.{0,2,4}); the frontend is checked
+by construction (shapes, silence, pure tones hitting the right mel band)
+since the reference's NumPy pipeline cannot be imported here.
+"""
+
+import numpy as np
+import pytest
+import torch
+from torch import nn
+
+import jax.numpy as jnp
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.models.vggish import mel
+from video_features_tpu.models.vggish.convert import convert_pca_params, convert_state_dict
+from video_features_tpu.models.vggish.model import build, postprocess
+
+
+# --- frontend ---------------------------------------------------------------
+
+def test_log_mel_shapes_and_silence():
+    # 1 s of silence at 16 kHz: 98 STFT frames -> one (96, 64) example
+    examples = mel.waveform_to_examples(np.zeros(16000, np.float32), 16000)
+    assert examples.shape == (1, 96, 64)
+    np.testing.assert_allclose(examples, np.log(0.01), atol=1e-5)
+
+
+def test_pure_tone_lights_matching_mel_band():
+    t = np.arange(16000 * 2) / 16000.0
+    for hz in (440.0, 1000.0, 3000.0):
+        tone = np.sin(2 * np.pi * hz * t).astype(np.float32)
+        examples = mel.waveform_to_examples(tone, 16000)
+        band_energy = examples.mean(axis=(0, 1))  # (64,)
+        # center frequencies of the 64 bands on the HTK mel scale
+        edges = np.linspace(mel.hertz_to_mel(125.0), mel.hertz_to_mel(7500.0), 66)
+        centers_hz = 700.0 * (np.exp(edges[1:-1] / 1127.0) - 1.0)
+        expected = np.argmin(np.abs(centers_hz - hz))
+        assert abs(int(band_energy.argmax()) - expected) <= 1
+
+
+def test_frame_drops_ragged_tail():
+    framed = mel.frame(np.arange(10.0), window_length=4, hop_length=3)
+    np.testing.assert_array_equal(framed, [[0, 1, 2, 3], [3, 4, 5, 6], [6, 7, 8, 9]])
+
+
+def test_resample_tone_preserved():
+    from video_features_tpu.io.audio import resample
+
+    t = np.arange(44100) / 44100.0
+    tone = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+    out = resample(tone, 44100, 16000)
+    assert abs(out.shape[0] - 16000) <= 1
+    spec = np.abs(np.fft.rfft(out))
+    assert abs(spec.argmax() - 440) <= 2  # 1 Hz bins
+
+
+# --- net --------------------------------------------------------------------
+
+class TorchVGGish(nn.Module):
+    def __init__(self):
+        super().__init__()
+        layers, in_ch = [], 1
+        for v in (64, "M", 128, "M", 256, 256, "M", 512, 512, "M"):
+            if v == "M":
+                layers.append(nn.MaxPool2d(2, 2))
+            else:
+                layers += [nn.Conv2d(in_ch, v, 3, padding=1), nn.ReLU(True)]
+                in_ch = v
+        self.features = nn.Sequential(*layers)
+        self.embeddings = nn.Sequential(
+            nn.Linear(512 * 4 * 6, 4096), nn.ReLU(True),
+            nn.Linear(4096, 4096), nn.ReLU(True),
+            nn.Linear(4096, 128), nn.ReLU(True),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.permute(0, 2, 3, 1).reshape(x.size(0), -1)
+        return self.embeddings(x)
+
+
+def test_vggish_matches_torch_oracle():
+    torch.manual_seed(0)
+    oracle = TorchVGGish().eval()
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd)
+
+    x = np.random.RandomState(0).randn(2, 96, 64, 1).astype(np.float32)
+    with torch.no_grad():
+        ref = oracle(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    out = build().apply({"params": params}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_converter_rejects_unconsumed():
+    torch.manual_seed(0)
+    sd = {k: v.numpy() for k, v in TorchVGGish().state_dict().items()}
+    sd["stray.weight"] = np.zeros(3, np.float32)
+    with pytest.raises(ValueError, match="unconsumed"):
+        convert_state_dict(sd)
+
+
+def test_postprocessor_matches_torch_math():
+    rng = np.random.RandomState(0)
+    emb = rng.randn(5, 128).astype(np.float32)
+    eig = rng.randn(128, 128).astype(np.float32) * 0.1
+    means = rng.randn(128).astype(np.float32)
+
+    t = torch.mm(torch.from_numpy(eig), torch.from_numpy(emb).t() - torch.from_numpy(means).reshape(-1, 1)).t()
+    t = torch.clamp(t, -2.0, 2.0)
+    ref = torch.round((t - (-2.0)) * (255.0 / 4.0)).numpy()
+
+    pca = convert_pca_params({"pca_eigen_vectors": eig, "pca_means": means.reshape(-1, 1)})
+    out = postprocess(jnp.asarray(emb), {k: jnp.asarray(v) for k, v in pca.items()})
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+    assert float(np.asarray(out).min()) >= 0 and float(np.asarray(out).max()) <= 255
+
+
+# --- end to end -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sample_wav(tmp_path_factory):
+    from scipy.io import wavfile
+
+    path = str(tmp_path_factory.mktemp("audio") / "chirp.wav")
+    t = np.arange(16000 * 3) / 16000.0
+    sig = 0.5 * np.sin(2 * np.pi * (200 + 300 * t) * t)
+    wavfile.write(path, 16000, (sig * 32767).astype(np.int16))
+    return path
+
+
+def test_extract_vggish_end_to_end(sample_wav, tmp_path):
+    from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
+
+    cfg = ExtractionConfig(
+        feature_type="vggish",
+        video_paths=[sample_wav],
+        on_extraction="save_numpy",
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    ex = ExtractVGGish(cfg)
+    ex([0])
+    import pathlib
+
+    saved = {p.name: p for p in pathlib.Path(tmp_path / "out").rglob("*.npy")}
+    assert set(saved) == {"chirp_vggish.npy"}
+    feats = np.load(saved["chirp_vggish.npy"])
+    # 3 s of audio -> 3 x 0.96 s examples
+    assert feats.shape == (3, 128)
+    assert np.isfinite(feats).all()
+    assert (feats >= 0).all()  # final ReLU

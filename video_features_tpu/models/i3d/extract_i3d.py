@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import form_slices, video_path_of
 from video_features_tpu.io.video import probe, read_frames_at_indices
 from video_features_tpu.models.common.weights import load_params
 from video_features_tpu.models.i3d.convert import convert_state_dict as i3d_convert
@@ -224,21 +225,62 @@ class ExtractI3D(BaseExtractor):
         return frames, fps, stamps
 
     def _load_flow_pairs(self, flow_dir: str):
-        """Sorted flow_x_*/flow_y_* JPEG pairs (ref extract_i3d.py:231-237)."""
+        """Sorted, stem-verified flow_x_*/flow_y_* JPEG pairs
+        (ref extract_i3d.py:231-237; hardened: numeric suffixes sort
+        numerically and x/y suffixes must match pairwise, so one missing
+        file fails loudly instead of silently desyncing every later pair)."""
         import pathlib
 
-        xs = sorted(pathlib.Path(flow_dir).glob("flow_x*.jpg"), key=lambda p: p.stem[7:])
-        ys = sorted(pathlib.Path(flow_dir).glob("flow_y*.jpg"), key=lambda p: p.stem[7:])
+        def key(p):
+            sfx = p.stem[7:]
+            return (0, int(sfx)) if sfx.isdigit() else (1, sfx)
+
+        xs = sorted(pathlib.Path(flow_dir).glob("flow_x*.jpg"), key=key)
+        ys = sorted(pathlib.Path(flow_dir).glob("flow_y*.jpg"), key=key)
+        if len(xs) != len(ys):
+            raise ValueError(
+                f"{flow_dir}: {len(xs)} flow_x vs {len(ys)} flow_y images"
+            )
+        for x, y in zip(xs, ys):
+            if x.stem[7:] != y.stem[7:]:
+                raise ValueError(f"flow pair mismatch: {x.name} vs {y.name}")
         return list(zip(xs, ys))
+
+    def _read_flow_images(self, flow_dir: str) -> np.ndarray:
+        """Decode every flow JPEG pair ONCE -> (N, H, W, 2) float32 (the
+        windows may overlap when step < stack; re-decoding per window
+        would repeat the disk reads)."""
+        pairs = self._load_flow_pairs(flow_dir)
+        imgs = np.stack(
+            [
+                np.stack(
+                    [
+                        cv2.imread(str(fx), cv2.IMREAD_GRAYSCALE),
+                        cv2.imread(str(fy), cv2.IMREAD_GRAYSCALE),
+                    ],
+                    axis=-1,
+                )
+                for fx, fy in pairs
+            ]
+        ).astype(np.float32) if pairs else np.zeros((0, 1, 1, 2), np.float32)
+        if len(pairs) and min(imgs.shape[1:3]) < CENTRAL_CROP_SIZE:
+            raise ValueError(
+                f"flow images {imgs.shape[1:3]} are smaller than the "
+                f"{CENTRAL_CROP_SIZE}px center crop"
+            )
+        return imgs
 
     # --- main --------------------------------------------------------------
     def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
         from_disk = self.flow_type == "flow"
         if from_disk:
-            video_path, flow_dir = path_entry
-            flows = self._load_flow_pairs(flow_dir)
-        else:
-            video_path = path_entry
+            if not isinstance(path_entry, (tuple, list)) or len(path_entry) != 2:
+                raise ValueError(
+                    "--flow_type flow needs (video, flow_dir) pairs; provide "
+                    "--flow_paths / --flow_dir alongside the videos"
+                )
+            flow_imgs = self._read_flow_images(path_entry[1])
+        video_path = video_path_of(path_entry)
         frames, fps, timestamps_ms = self._sample_frames(video_path)
         if not frames:
             raise IOError(f"no frames decoded from {video_path}")
@@ -249,36 +291,21 @@ class ExtractI3D(BaseExtractor):
 
         feats: Dict[str, List[np.ndarray]] = {s: [] for s in self.streams}
         window = self.stack_size + (0 if from_disk else 1)
-        stack_counter = 0
-        start = 0
-        while start + window <= len(frames):
-            stack = np.stack(frames[start : start + window])
+        # with disk flow the reference zips frames with flow pairs, so the
+        # windowed extent truncates to the shorter (ref extract_i3d.py:266)
+        extent = min(len(frames), len(flow_imgs)) if from_disk else len(frames)
+        for stack_counter, (start, end) in enumerate(
+            form_slices(extent, window, self.step_size)
+        ):
+            stack = np.stack(frames[start:end])
             x = jax.device_put(jnp.asarray(stack), state["device"])
             for stream in self.streams:
                 if stream == "rgb":
                     f, logits = fns["rgb"](state["params"]["rgb"], x)
                 elif from_disk:
-                    pair_slice = flows[start : start + window]
-                    imgs = np.stack(
-                        [
-                            np.stack(
-                                [
-                                    cv2.imread(str(fx), cv2.IMREAD_GRAYSCALE),
-                                    cv2.imread(str(fy), cv2.IMREAD_GRAYSCALE),
-                                ],
-                                axis=-1,
-                            )
-                            for fx, fy in pair_slice
-                        ]
-                    ).astype(np.float32)
-                    if min(imgs.shape[1:3]) < CENTRAL_CROP_SIZE:
-                        raise ValueError(
-                            f"flow images {imgs.shape[1:3]} are smaller than "
-                            f"the {CENTRAL_CROP_SIZE}px center crop"
-                        )
                     f, logits = fns["flow"](
                         state["params"]["flow"],
-                        jax.device_put(jnp.asarray(imgs), state["device"]),
+                        jax.device_put(jnp.asarray(flow_imgs[start:end]), state["device"]),
                     )
                 else:
                     f, logits = fns["flow"](
@@ -288,8 +315,6 @@ class ExtractI3D(BaseExtractor):
                 if self.config.show_pred:
                     print(f"{video_path} @ stack {stack_counter} ({stream} stream)")
                     show_predictions_on_dataset(np.asarray(logits)[0], "kinetics")
-            start += self.step_size
-            stack_counter += 1
 
         out: Dict[str, np.ndarray] = {
             s: np.array(feats[s], dtype=np.float32).reshape(-1, 1024)

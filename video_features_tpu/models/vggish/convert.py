@@ -1,0 +1,49 @@
+"""torchvggish checkpoint (vggish-10086976.pth) -> Flax param tree,
+plus the PCA-params checkpoint for the optional postprocessor.
+
+torch naming (ref models/vggish_torch/vggish_src/vggish.py:120-130):
+``features.{0,3,6,8,11,13}.{weight,bias}`` convs and
+``embeddings.{0,2,4}.{weight,bias}`` linears;
+PCA file holds ``pca_eigen_vectors`` (128,128) / ``pca_means`` (128,).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from video_features_tpu.models.common.weights import (
+    check_all_consumed,
+    conv2d_kernel,
+    strip_prefix,
+    transpose_linear,
+)
+from video_features_tpu.models.vggish.model import _CONV_LAYOUT
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray]):
+    sd = strip_prefix(sd, "module.")
+    consumed = set()
+    params = {}
+    for idx, _ in _CONV_LAYOUT:
+        consumed.update((f"features.{idx}.weight", f"features.{idx}.bias"))
+        params[f"features_{idx}"] = {
+            "kernel": conv2d_kernel(sd[f"features.{idx}.weight"]),
+            "bias": sd[f"features.{idx}.bias"],
+        }
+    for idx in (0, 2, 4):
+        consumed.update((f"embeddings.{idx}.weight", f"embeddings.{idx}.bias"))
+        params[f"embeddings_{idx}"] = {
+            "kernel": transpose_linear(sd[f"embeddings.{idx}.weight"]),
+            "bias": sd[f"embeddings.{idx}.bias"],
+        }
+    check_all_consumed(sd, consumed, "VGGish")
+    return params
+
+
+def convert_pca_params(sd: Dict[str, np.ndarray]):
+    return {
+        "pca_eigen_vectors": np.asarray(sd["pca_eigen_vectors"], np.float32),
+        "pca_means": np.asarray(sd["pca_means"], np.float32).reshape(-1),
+    }

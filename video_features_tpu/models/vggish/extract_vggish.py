@@ -1,0 +1,79 @@
+"""VGGish audio extractor (ref models/vggish/extract_vggish.py and
+models/vggish_torch/extract_vggish.py — one extractor serves both
+``vggish`` and ``vggish_torch``: the reference variants differ only in
+runtime (TF1 session vs torch), not in contract).
+
+Per input: ``.wav`` consumed directly; video containers ripped via
+ffmpeg when available (ref utils/utils.py:247-276); waveform -> log-mel
+(96, 64) examples on the host -> examples batched to a bucketed static
+shape -> jit VGG -> raw (N, 128) float embeddings.
+
+Output contract: ``{vggish: (Ta, 128)}``, Ta = duration/0.96 s; no
+fps/timestamps meta (ref extract_vggish.py:105-108).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.audio import load_audio_for_model
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.vggish.convert import convert_state_dict
+from video_features_tpu.models.vggish.mel import SAMPLE_RATE, waveform_to_examples
+from video_features_tpu.models.vggish.model import (
+    VGGISH_EMBEDDING_DIM,
+    build,
+    init_params,
+)
+from video_features_tpu.ops.window import bucket_size, pad_batch
+
+
+class ExtractVGGish(BaseExtractor):
+    def __init__(self, config, external_call: bool = False) -> None:
+        super().__init__(config, external_call)
+        self._host_params = None
+
+    def _load_host_params(self):
+        if self._host_params is None:
+            if self.config.weights_path:
+                self._host_params = load_params(
+                    self.config.weights_path, convert_state_dict
+                )
+            else:
+                self._host_params = init_params()
+        return self._host_params
+
+    def _build(self, device):
+        model = build()
+        params = jax.device_put(self._load_host_params(), device)
+
+        @jax.jit
+        def forward(p, x):  # (B, 96, 64, 1)
+            return model.apply({"params": p}, x)
+
+        return {"params": params, "forward": forward, "device": device}
+
+    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+        path = video_path_of(path_entry)
+        samples = load_audio_for_model(
+            path, SAMPLE_RATE, self.tmp_path, self.config.keep_tmp_files
+        )
+        examples = waveform_to_examples(samples, SAMPLE_RATE)  # (N, 96, 64)
+        n = examples.shape[0]
+        if n == 0:
+            return {
+                self.feature_type: np.zeros((0, VGGISH_EMBEDDING_DIM), np.float32)
+            }
+        x = pad_batch(
+            examples[..., None], bucket_size(n, buckets=self.config.shape_buckets)
+        )
+        x = jax.device_put(jnp.asarray(x), state["device"])
+        feats = np.asarray(state["forward"](state["params"], x))[:n]
+        return {self.feature_type: feats}

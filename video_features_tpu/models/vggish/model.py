@@ -1,0 +1,73 @@
+"""VGGish (AudioSet VGG) in Flax + the PCA/quantize postprocessor.
+
+Reference: the torchvggish port the reference vendors
+(ref models/vggish_torch/vggish_src/vggish.py:9-189): VGG-style conv
+stack [64, M, 128, M, 256, 256, M, 512, 512, M] on (96, 64) log-mel
+patches, then 4096-4096-128 fully-connected embeddings with a FINAL ReLU.
+NHWC here; torch flattens (N, 512, 6, 4) as (H, W, C) before the first
+Linear, which is exactly the natural NHWC flatten, so converted Linear
+weights apply unchanged.
+
+Both reference extractors emit the RAW 128-d floats — the TF variant
+instantiates its PCA postprocessor but never applies it
+(ref models/vggish/extract_vggish.py:56,100-104) and the torch variant
+passes ``postprocess=False`` (ref models/vggish_torch/extract_vggish.py:
+51-52). :func:`postprocess` is provided for library users wanting the
+AudioSet-compatible 8-bit embeddings (ref vggish.py:34-105).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+VGGISH_EMBEDDING_DIM = 128
+QUANTIZE_MIN_VAL = -2.0
+QUANTIZE_MAX_VAL = 2.0
+
+# torch Sequential indices of the convs in make_layers() (ref vggish.py:120-130)
+_CONV_LAYOUT: Tuple[Tuple[int, int], ...] = (
+    (0, 64), (3, 128), (6, 256), (8, 256), (11, 512), (13, 512),
+)
+_POOL_AFTER = {0, 3, 8, 13}  # a 2x2 max pool follows these convs
+
+
+class VGGishNet(nn.Module):
+    """(N, 96, 64, 1) log-mel examples -> (N, 128) embeddings."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for idx, ch in _CONV_LAYOUT:
+            x = nn.relu(
+                nn.Conv(ch, (3, 3), padding=[(1, 1), (1, 1)], name=f"features_{idx}")(x)
+            )
+            if idx in _POOL_AFTER:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)  # (N, 6*4*512), NHWC == torch's flatten
+        x = nn.relu(nn.Dense(4096, name="embeddings_0")(x))
+        x = nn.relu(nn.Dense(4096, name="embeddings_2")(x))
+        return nn.relu(nn.Dense(VGGISH_EMBEDDING_DIM, name="embeddings_4")(x))
+
+
+def postprocess(embeddings: jnp.ndarray, pca: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """AudioSet PCA-whiten + 8-bit quantize (ref vggish.py:47-105):
+    clip((x - means) @ E^T, ±2) mapped to [0, 255] and rounded."""
+    centered = embeddings - pca["pca_means"].reshape(1, -1)
+    applied = centered @ pca["pca_eigen_vectors"].T
+    clipped = jnp.clip(applied, QUANTIZE_MIN_VAL, QUANTIZE_MAX_VAL)
+    return jnp.round(
+        (clipped - QUANTIZE_MIN_VAL) * (255.0 / (QUANTIZE_MAX_VAL - QUANTIZE_MIN_VAL))
+    )
+
+
+def build() -> VGGishNet:
+    return VGGishNet()
+
+
+def init_params(seed: int = 0):
+    model = build()
+    dummy = jnp.zeros((1, 96, 64, 1), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy)["params"]
