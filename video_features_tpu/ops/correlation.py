@@ -40,6 +40,7 @@ def local_correlation(
     fmap1: jnp.ndarray,
     fmap2: jnp.ndarray,
     max_displacement: int = 4,
+    method: str = "auto",
 ) -> jnp.ndarray:
     """PWC local correlation: (N, C, H, W) x2 -> (N, (2d+1)^2, H, W).
 
@@ -47,7 +48,23 @@ def local_correlation(
     ``f1[y, x] * f2[y+dy, x+dx]``, zero-padded — matching the reference
     CUDA kernel including its 1/C normalization (ref
     pwc_src/correlation.py:106-108).
+
+    ``method``: 'auto' uses the Pallas VMEM-tiled kernel on TPU backends
+    and the XLA shifted-reduce formulation elsewhere; 'pallas'/'xla'
+    force one. The Pallas kernel is forward-only — anything needing
+    ``jax.grad`` through this op must pass method='xla'.
     """
+    if method == "auto":
+        method = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if method == "pallas":
+        from video_features_tpu.ops.pallas.correlation_kernel import (
+            local_correlation_pallas,
+        )
+
+        return local_correlation_pallas(fmap1, fmap2, max_displacement)
+    if method != "xla":
+        raise ValueError(f"method must be auto|pallas|xla, got {method!r}")
+
     N, C, H, W = fmap1.shape
     d = max_displacement
     f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (d, d), (d, d)))

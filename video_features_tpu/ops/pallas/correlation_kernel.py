@@ -1,0 +1,116 @@
+"""Pallas TPU kernel for PWC's 81-channel cost volume.
+
+The native equivalent of the reference's four CUDA-C kernels embedded as
+strings and JIT-compiled per device through CuPy
+(ref models/pwc/pwc_src/correlation.py:17-242): output channel
+``(dy+4)*9 + (dx+4)`` holds ``mean_c f1[c,y,x] * f2[c,y+dy,x+dx]`` with
+zero padding outside f2 (ref kernel_Correlation_updateOutput :44-112).
+
+Mapping to TPU (the CUDA kernel's shared-memory patch staging becomes
+VMEM tiling, SURVEY.md §7 hard part #3):
+
+- Layout (N, C, H, W): W rides the 128-lane axis, the C-reduction runs
+  over leading dims on the VPU, and each displacement's (TH, W) plane is
+  one contiguous store.
+- Grid (N, H/TH). f1's row tile auto-DMAs into VMEM; f2 (pre-padded by
+  the 4-px halo) stays in HBM (`pl.ANY`) and the kernel DMAs the
+  (C, TH+8, W+8) halo'd row tile into VMEM scratch ONCE per program —
+  all 81 shifted windows then read from VMEM, so each input byte crosses
+  HBM exactly once regardless of the 81-fold reuse.
+- Python-level loop over the 81 displacements unrolls into a fused
+  multiply-reduce chain on the VPU.
+
+Forward only: the framework is an inference pipeline (SURVEY.md §0), so
+the reference's two backward kernels have no call sites; anything that
+needs `jax.grad` through this op must call the XLA formulation in
+ops/correlation.py (method='xla'), which XLA differentiates itself.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(f1_ref, f2p_ref, out_ref, f2_tile, sem, *, disp: int, tile_h: int):
+    n = pl.program_id(0)
+    ty = pl.program_id(1)
+    C = f1_ref.shape[1]
+    W = f1_ref.shape[3]
+
+    # stage the halo'd f2 row tile HBM -> VMEM once; 81 windows reuse it.
+    # The copy slices only the (8-aligned) H axis — full lane width, since
+    # Mosaic requires DMA slices 128-aligned along the last dim.
+    copy = pltpu.make_async_copy(
+        f2p_ref.at[n, :, pl.ds(ty * tile_h, tile_h + disp - 1), :],
+        f2_tile,
+        sem,
+    )
+    copy.start()
+    copy.wait()
+
+    f1 = f1_ref[0]  # (C, TH, W)
+    planes = []
+    for dy in range(disp):
+        for dx in range(disp):
+            f2 = f2_tile[:, dy : dy + tile_h, dx : dx + W]  # (C, TH, W)
+            planes.append(jnp.sum(f1 * f2, axis=0) / C)  # /C: exact mean
+    out_ref[0] = jnp.stack(planes, axis=0)  # (disp^2, TH, W)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_displacement", "tile_h", "interpret")
+)
+def local_correlation_pallas(
+    fmap1: jnp.ndarray,
+    fmap2: jnp.ndarray,
+    max_displacement: int = 4,
+    tile_h: int = 8,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(N, C, H, W) x2 -> (N, (2d+1)^2, H, W), matching
+    ops.correlation.local_correlation bit-for-bit in fp32."""
+    N, C, H, W = fmap1.shape
+    d = max_displacement
+    disp = 2 * d + 1
+    if tile_h % 8:
+        raise ValueError(f"tile_h must be a multiple of 8 (sublane), got {tile_h}")
+    n_tiles = pl.cdiv(H, tile_h)
+    hp = n_tiles * tile_h
+    # halo pad: d low + (d + tile remainder) high in H so the last tile's
+    # DMA stays in bounds; W padded out to a 128-lane multiple because the
+    # row-tile DMA must span the full (tile-aligned) lane dimension
+    w_tot = ((W + 2 * d + 127) // 128) * 128
+    f2p = jnp.pad(
+        fmap2, ((0, 0), (0, 0), (d, d + hp - H), (d, w_tot - W - d))
+    )
+
+    kernel = functools.partial(_kernel, disp=disp, tile_h=tile_h)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N, n_tiles),
+        in_specs=[
+            pl.BlockSpec(
+                (1, C, tile_h, W),
+                lambda n, ty: (n, 0, ty, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, disp * disp, tile_h, W),
+            lambda n, ty: (n, 0, ty, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((N, disp * disp, H, W), fmap1.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((C, tile_h + disp - 1, w_tot), fmap1.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(fmap1, f2p)
+    return out
