@@ -1,0 +1,71 @@
+"""Native C++ preprocess library: build, parity vs the PIL chain,
+threading invariance, and the ResNet opt-in path."""
+
+import numpy as np
+import pytest
+
+from video_features_tpu import native
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.ops.preprocess import imagenet_preprocess
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"no native toolchain: {native.build_error()}"
+)
+
+
+def _frames(n=3, h=240, w=320, seed=0):
+    rng = np.random.RandomState(seed)
+    # smooth-ish content so resize differences are representative
+    base = rng.randint(0, 256, size=(n, h // 8, w // 8, 3), dtype=np.uint8)
+    return np.stack(
+        [np.kron(f, np.ones((8, 8, 1))).astype(np.uint8) for f in base]
+    )
+
+
+def test_matches_pil_chain_closely():
+    frames = _frames()
+    ref = np.stack([imagenet_preprocess(f) for f in frames])
+    out = native.imagenet_preprocess_batch(frames)
+    assert out.shape == ref.shape == (3, 3, 224, 224)
+    # PIL quantizes filter coefficients to 8-bit fixed point; the native
+    # path is float. Per-pixel differences stay at the quantization scale.
+    diff = np.abs(out - ref)
+    assert diff.mean() < 0.01
+    assert diff.max() < 0.08
+
+
+def test_threading_is_deterministic():
+    frames = _frames(n=8, h=120, w=160)
+    a = native.imagenet_preprocess_batch(frames, threads=1)
+    b = native.imagenet_preprocess_batch(frames, threads=8)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_upscale_path():
+    frames = _frames(n=1, h=112, w=100)  # smaller than the 256 resize target
+    out = native.imagenet_preprocess_batch(frames)
+    assert out.shape == (1, 3, 224, 224)
+    assert np.isfinite(out).all()
+
+
+def test_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        native.imagenet_preprocess_batch(np.zeros((2, 8, 8), np.uint8))
+
+
+def test_extract_resnet_native_preprocess(sample_video, tmp_path):
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    cfg = ExtractionConfig(
+        feature_type="resnet18",
+        video_paths=[sample_video],
+        extraction_fps=2.0,
+        batch_size=4,
+        host_preprocess="native",
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    res = ExtractResNet(cfg, external_call=True)([0])
+    assert res[0]["resnet18"].shape[1] == 512
+    assert np.isfinite(res[0]["resnet18"]).all()

@@ -87,6 +87,12 @@ class ExtractionConfig:
     weights_path: Optional[str] = None
     # Host-side decode worker threads feeding each device queue.
     decode_workers: int = 2
+    # Host preprocessing backend for the PIL-chain extractors (currently
+    # the ResNet family): 'pil' reproduces the reference bit-for-bit;
+    # 'native' uses the threaded C++ library (native/preprocess.cpp,
+    # within ~1/255/pixel of PIL) for throughput. Other extractors
+    # preprocess on-device and ignore this knob.
+    host_preprocess: str = "pil"
     # Resolution buckets for XLA static shapes (see ops/window.py).
     shape_buckets: Optional[List[int]] = None
 
@@ -174,6 +180,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--weights_path", type=str, default=None)
     p.add_argument("--decode_workers", type=int, default=2)
+    p.add_argument("--host_preprocess", default="pil", choices=["pil", "native"])
     return p
 
 

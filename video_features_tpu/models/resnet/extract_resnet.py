@@ -14,6 +14,7 @@ Output contract: ``{resnetXX: (T, feat_dim), fps, timestamps_ms}``
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List
 
 import numpy as np
@@ -36,6 +37,8 @@ class ExtractResNet(BaseExtractor):
         super().__init__(config, external_call)
         self.batch_size = max(int(self.config.batch_size or 1), 1)
         self._host_params = None
+        self._use_native = None  # decided (with one-time warning) on first batch
+        self._native_threads = 1
 
     def _load_host_params(self):
         if self._host_params is None:
@@ -58,11 +61,45 @@ class ExtractResNet(BaseExtractor):
 
         return {"params": params, "forward": forward, "device": device}
 
+    def _preprocess_batch(self, batch: List[np.ndarray]) -> np.ndarray:
+        """raw uint8 HWC frames -> (n, 3, 224, 224) normalized float32.
+
+        'native' routes through the threaded C++ chain (same-resolution
+        frames batched in one call); 'pil' is the reference-exact path.
+        The backend decision (and any unavailability warning) happens once."""
+        if self._use_native is None:
+            if self.config.host_preprocess == "native":
+                from video_features_tpu import native
+
+                self._use_native = native.available()
+                if not self._use_native:
+                    print(
+                        f"native preprocess unavailable "
+                        f"({native.build_error()}); using PIL"
+                    )
+                else:
+                    # share host cores across concurrent device workers
+                    from video_features_tpu.parallel.devices import resolve_devices
+
+                    n_workers = max(len(resolve_devices(self.config)), 1)
+                    self._native_threads = max(
+                        (os.cpu_count() or 1) // n_workers, 1
+                    )
+            else:
+                self._use_native = False
+        if self._use_native:
+            from video_features_tpu import native
+
+            return native.imagenet_preprocess_batch(
+                np.stack(batch), threads=self._native_threads
+            )
+        return np.stack([imagenet_preprocess(f) for f in batch])
+
     def _run_batch(self, state, batch: List[np.ndarray], feats_out: List[np.ndarray]):
         """Pad to the static batch size, run, keep the valid rows
         (ref extract_resnet.py:104-116)."""
         n = len(batch)
-        x = np.stack(batch)
+        x = self._preprocess_batch(batch)
         if n < self.batch_size:
             x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
         x = jax.device_put(jnp.asarray(x), state["device"])
@@ -79,7 +116,7 @@ class ExtractResNet(BaseExtractor):
         timestamps_ms: List[float] = []
         actual_fps = None
         for frame, ts in stream_frames(video_path, fps):
-            batch.append(imagenet_preprocess(frame))
+            batch.append(frame)  # raw uint8; preprocessing happens per batch
             timestamps_ms.append(ts)
             if len(batch) == self.batch_size:
                 self._run_batch(state, batch, feats_out)

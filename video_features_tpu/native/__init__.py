@@ -1,0 +1,125 @@
+"""Native (C++) host-side components: build-on-demand + ctypes bindings.
+
+The shared library compiles once per machine into ``native/_build/`` with
+plain g++ (no pybind11 in the image; the C ABI + ctypes is the binding
+layer). Everything degrades gracefully: ``available()`` is False when no
+toolchain exists and callers fall back to the PIL/numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "preprocess.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libvfpreproc.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _compile() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # compile to a per-pid temp and rename: concurrent processes may race
+    # on the shared output path, and dlopen of a half-written .so would
+    # poison this process's native path for the whole run
+    tmp_out = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", tmp_out,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            return proc.stderr[-2000:]
+        os.replace(tmp_out, _LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"{type(e).__name__}: {e}"
+    finally:
+        if os.path.exists(tmp_out):
+            try:
+                os.remove(tmp_out)
+            except OSError:
+                pass
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        ):
+            err = _compile()
+            if err is not None:
+                _build_error = err
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            _build_error = str(e)
+            return None
+        lib.imagenet_preprocess_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        lib.imagenet_preprocess_batch.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def imagenet_preprocess_batch(
+    frames: np.ndarray,
+    resize_to: int = 256,
+    crop: int = 224,
+    mean: Sequence[float] = (0.485, 0.456, 0.406),
+    std: Sequence[float] = (0.229, 0.224, 0.225),
+    threads: int = 0,
+) -> np.ndarray:
+    """(N, H, W, 3) uint8 frames -> (N, 3, crop, crop) float32 via the
+    threaded C++ chain (near-PIL antialiased resize; see preprocess.cpp)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native preprocess unavailable: {_build_error}")
+    frames = np.ascontiguousarray(frames, dtype=np.uint8)
+    if frames.ndim != 4 or frames.shape[-1] != 3:
+        raise ValueError(f"expected (N, H, W, 3) uint8, got {frames.shape}")
+    n, h, w, _ = frames.shape
+    if min(h, w) < 1 or crop < 1 or resize_to < crop:
+        raise ValueError(f"bad sizes: frame {h}x{w}, resize {resize_to}, crop {crop}")
+    out = np.empty((n, 3, crop, crop), np.float32)
+    mean_a = np.ascontiguousarray(mean, np.float32)
+    std_a = np.ascontiguousarray(std, np.float32)
+    if threads <= 0:
+        threads = min(max(os.cpu_count() or 1, 1), 16)
+    lib.imagenet_preprocess_batch(
+        frames.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, resize_to, crop,
+        mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        threads,
+    )
+    return out
