@@ -93,6 +93,12 @@ class ExtractionConfig:
     # within ~1/255/pixel of PIL) for throughput. Other extractors
     # preprocess on-device and ignore this knob.
     host_preprocess: str = "pil"
+    # Skip videos whose output files already exist (job-level resume; the
+    # reference recomputes and overwrites unconditionally).
+    resume: bool = False
+    # When set, wrap extraction in a jax.profiler trace written here and
+    # print a per-stage wall-time summary at the end.
+    profile_dir: Optional[str] = None
     # Resolution buckets for XLA static shapes (see ops/window.py).
     shape_buckets: Optional[List[int]] = None
 
@@ -181,6 +187,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--weights_path", type=str, default=None)
     p.add_argument("--decode_workers", type=int, default=2)
     p.add_argument("--host_preprocess", default="pil", choices=["pil", "native"])
+    p.add_argument("--resume", action="store_true", default=False,
+                   help="skip videos whose outputs already exist")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="write a jax.profiler trace + stage timing summary")
     return p
 
 

@@ -26,7 +26,8 @@ from tqdm import tqdm
 
 from video_features_tpu.config import as_config
 from video_features_tpu.io.paths import form_list_from_user_input, video_path_of
-from video_features_tpu.io.sink import action_on_extraction
+from video_features_tpu.io.sink import action_on_extraction, expected_output_files
+from video_features_tpu.utils.profiling import StageTimer, device_trace
 
 
 class BaseExtractor:
@@ -50,6 +51,22 @@ class BaseExtractor:
         self.tmp_path = os.path.join(self.config.tmp_path, self.feature_type)
         self._device_state: Dict[Any, Any] = {}
         self._build_lock = threading.Lock()
+        self.timer = StageTimer()
+
+    def feature_keys(self):
+        """The keys a feats_dict will carry (used by --resume to probe for
+        existing outputs). I3D overrides with its streams."""
+        return [self.feature_type]
+
+    def _already_done(self, entry) -> bool:
+        files = expected_output_files(
+            self.feature_keys(),
+            video_path_of(entry),
+            self.output_path,
+            self.config.on_extraction,
+            self.config.output_direct,
+        )
+        return bool(files) and all(os.path.exists(f) for f in files)
 
     # --- per-device model state -------------------------------------------
     def _build(self, device) -> Any:
@@ -86,27 +103,39 @@ class BaseExtractor:
         state = self.warmup(device)
 
         results: List[Dict[str, np.ndarray]] = []
-        for idx in indices:
-            entry = self.path_list[int(idx)]
-            try:
-                feats_dict = self.extract(device, state, entry)
-                if self.external_call:
-                    results.append(feats_dict)
-                else:
-                    action_on_extraction(
-                        feats_dict,
-                        video_path_of(entry),
-                        self.output_path,
-                        self.config.on_extraction,
-                        self.config.output_direct,
-                    )
-            except KeyboardInterrupt:
-                raise
-            except Exception:  # noqa: BLE001 - per-video isolation (ref extract_clip.py:78-84)
-                print(f"An error occurred extracting {video_path_of(entry)}:")
-                traceback.print_exc()
-                print("Continuing...")
-            self.progress.update()
+        with device_trace(self.config.profile_dir):
+            for idx in indices:
+                entry = self.path_list[int(idx)]
+                try:
+                    if (
+                        self.config.resume
+                        and not self.external_call
+                        and self._already_done(entry)
+                    ):
+                        self.progress.update()
+                        continue
+                    with self.timer.stage("extract"):
+                        feats_dict = self.extract(device, state, entry)
+                    if self.external_call:
+                        results.append(feats_dict)
+                    else:
+                        with self.timer.stage("sink"):
+                            action_on_extraction(
+                                feats_dict,
+                                video_path_of(entry),
+                                self.output_path,
+                                self.config.on_extraction,
+                                self.config.output_direct,
+                            )
+                except KeyboardInterrupt:
+                    raise
+                except Exception:  # noqa: BLE001 - per-video isolation (ref extract_clip.py:78-84)
+                    print(f"An error occurred extracting {video_path_of(entry)}:")
+                    traceback.print_exc()
+                    print("Continuing...")
+                self.progress.update()
+        if self.config.profile_dir:
+            print(self.timer.summary())
         if self.external_call:
             return results
         return None

@@ -18,6 +18,46 @@ from typing import Dict, List, Union
 import numpy as np
 
 META_KEYS = ("fps", "timestamps_ms")
+_SUFFIX = {"save_numpy": "npy", "save_pickle": "pkl"}
+
+
+def output_file_name(name: str, key: str, on_extraction: str, output_direct: bool) -> str:
+    """The single source of the ``<stem>_<key>.<ext>`` naming rule, shared
+    by the saver and the ``--resume`` probe so they can never drift.
+    Feature types may contain '/' (CLIP-ViT-B/32); sanitized so the file
+    name stays flat and '<stem>_<key>' stays greppable."""
+    suffix = _SUFFIX[on_extraction]
+    if output_direct:
+        return f"{name}.{suffix}"
+    return f"{name}_{key.replace('/', '-')}.{suffix}"
+
+
+def expected_output_files(
+    feature_keys,
+    video_path: Union[str, List[str]],
+    output_path: str,
+    on_extraction: str,
+    output_direct: bool = False,
+) -> List[str]:
+    """The files a successful save would produce — the skip-if-done probe
+    for ``--resume`` (the reference always recomputes and overwrites,
+    ref utils/utils.py:92-95). Empty for non-file sinks AND for save_jpg
+    (per-frame jpg dirs have no cheap completeness probe), so those modes
+    always recompute — safe, never wrong."""
+    if on_extraction not in _SUFFIX:
+        return []
+    if isinstance(video_path, (list, tuple)):
+        video_path = video_path[0]
+    name = pathlib.Path(video_path).stem
+    # dict.fromkeys: output_direct collapses every key to one file
+    return list(
+        dict.fromkeys(
+            os.path.join(
+                output_path, output_file_name(name, key, on_extraction, output_direct)
+            )
+            for key in feature_keys
+        )
+    )
 
 
 def action_on_extraction(
@@ -42,22 +82,21 @@ def action_on_extraction(
             print(f"max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}")
             print()
         elif on_extraction in ("save_numpy", "save_pickle"):
-            # feature types may contain '/' (CLIP-ViT-B/32); sanitized so
-            # the file name stays flat and '<stem>_<key>' stays greppable
-            # (the reference's np.save would crash on the nested path —
-            # ref utils/utils.py:81-93 only makes output_path)
-            safe_key = key.replace("/", "-")
-            fname = f"{name}.{suffix[on_extraction]}" if output_direct \
-                else f"{name}_{safe_key}.{suffix[on_extraction]}"
-            fpath = os.path.join(output_path, fname)
+            fpath = os.path.join(
+                output_path, output_file_name(name, key, on_extraction, output_direct)
+            )
             os.makedirs(os.path.dirname(fpath), exist_ok=True)
             if len(value) == 0:
                 print(f"Warning: the value is empty for {key} @ {fpath}")
-            if on_extraction == "save_numpy":
-                np.save(fpath, value)
-            else:
-                with open(fpath, "wb") as f:
+            # write tmp + rename: a run killed mid-save must not leave a
+            # truncated file that --resume would then trust as complete
+            tmp = f"{fpath}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                if on_extraction == "save_numpy":
+                    np.save(f, value)
+                else:
                     pickle.dump(value, f)
+            os.replace(tmp, fpath)
         elif on_extraction == "save_jpg":
             # flow (T, 2, H, W) -> per-pair x/y grayscale jpgs
             from PIL import Image

@@ -78,6 +78,9 @@ class ExtractI3D(BaseExtractor):
         self.step_size = int(self.config.step_size or DEFAULT_STEP_SIZE)
         self._host_params: Dict[str, object] = {}
 
+    def feature_keys(self):
+        return list(self.streams)  # i3d saves <stem>_rgb.npy / <stem>_flow.npy
+
     # --- weights -----------------------------------------------------------
     def _weights_file(self, kind: str):
         root = self.config.weights_path

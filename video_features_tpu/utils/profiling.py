@@ -1,0 +1,70 @@
+"""Tracing & per-stage timing — first-class here, absent in the reference
+(SURVEY.md §5: tqdm was its only observability).
+
+``device_trace(dir)`` wraps a region in a ``jax.profiler`` trace
+(XPlane/TensorBoard format, viewable with xprof/tensorboard-profile).
+The profiler is process-global, so concurrent device workers share one
+refcounted trace session. ``StageTimer`` aggregates wall-clock per
+pipeline stage (decode / preprocess / device / sink) across videos.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+_trace_lock = threading.Lock()
+_trace_refs = 0
+
+
+@contextmanager
+def device_trace(profile_dir: Optional[str]) -> Iterator[None]:
+    """Refcounted jax.profiler trace over a region; no-op when dir is None."""
+    global _trace_refs
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with _trace_lock:
+        if _trace_refs == 0:
+            jax.profiler.start_trace(profile_dir)
+        _trace_refs += 1
+    try:
+        yield
+    finally:
+        with _trace_lock:
+            _trace_refs -= 1
+            if _trace_refs == 0:
+                jax.profiler.stop_trace()
+
+
+class StageTimer:
+    """Thread-safe accumulated wall time per named stage."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.seconds: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.seconds[name] += dt
+                self.counts[name] += 1
+
+    def summary(self) -> str:
+        with self._lock:
+            rows = [
+                f"  {name:<12} {self.seconds[name]:8.2f}s over {self.counts[name]} calls"
+                for name in sorted(self.seconds)
+            ]
+        return "per-stage wall time:\n" + "\n".join(rows) if rows else ""
