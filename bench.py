@@ -37,6 +37,7 @@ def main() -> None:
             os.path.join(tmp, "bench.mp4"), n_frames=120, width=640, height=360
         )
         cfg = ExtractionConfig(
+            allow_random_init=True,
             feature_type="CLIP-ViT-B/32",
             video_paths=[video] * n_videos,
             extract_method="uni_12",

@@ -23,6 +23,7 @@ def test_resume_skips_existing(sample_video, tmp_path, monkeypatch):
     from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="resnet18",
         video_paths=[sample_video],
         extraction_fps=2.0,
@@ -58,6 +59,7 @@ def test_error_isolation_continues(sample_video, tmp_path, capsys):
     from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="resnet18",
         video_paths=[str(bad), sample_video],
         extraction_fps=2.0,

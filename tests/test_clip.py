@@ -115,6 +115,7 @@ def test_extract_clip_end_to_end(sample_video, tmp_path):
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="CLIP-ViT-B/32",
         video_paths=[sample_video],
         extract_method="uni_12",
@@ -139,6 +140,7 @@ def test_extract_clip_external_call(sample_video, tmp_path):
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="CLIP-ViT-B/32",
         video_paths=[sample_video],
         extract_method="uni_3",

@@ -109,11 +109,31 @@ def test_sink_save_numpy_and_pickle_naming(tmp_path):
 
 
 def test_sink_save_jpg_flow(tmp_path):
-    flow = np.random.RandomState(0).randint(0, 255, (2, 2, 8, 8)).astype(np.float32)
+    """save_jpg quantizes raw flow with the I3D uint8 map and names files
+    the way the flow-from-disk reader globs (flow_x_*.jpg)."""
+    import cv2
+
+    # smooth field (like real flow); pure noise would be JPEG's worst case
+    yy, xx = np.mgrid[0:48, 0:48].astype(np.float32)
+    base = np.stack([np.sin(xx / 8) * 10, np.cos(yy / 8) * 10])
+    flow = np.stack([base, -base])  # (2, 2, 48, 48)
     action_on_extraction({"raft": flow}, "v.mp4", str(tmp_path), "save_jpg")
     assert sorted(os.listdir(tmp_path / "v")) == [
-        "00000_x.jpg", "00000_y.jpg", "00001_x.jpg", "00001_y.jpg"
+        "flow_x_00000.jpg", "flow_x_00001.jpg",
+        "flow_y_00000.jpg", "flow_y_00001.jpg",
     ]
+    # pixels round-trip the 128 + 255/40*f quantization within JPEG error
+    img = cv2.imread(str(tmp_path / "v" / "flow_x_00000.jpg"), cv2.IMREAD_GRAYSCALE)
+    expected = np.round(128.0 + 255.0 / 40.0 * np.clip(flow[0, 0], -20, 20))
+    assert np.abs(img.astype(np.float32) - expected).mean() < 3.0
+
+
+def test_sink_save_jpg_rejects_non_flow(tmp_path):
+    with pytest.raises(ValueError, match="save_jpg"):
+        action_on_extraction(
+            {"r21d_rgb": np.zeros((2, 512), np.float32)}, "v.mp4",
+            str(tmp_path), "save_jpg",
+        )
 
 
 def test_sink_print_runs(capsys):
@@ -186,3 +206,69 @@ def test_labels_load_and_show(capsys):
     show_predictions_on_dataset(logits, "imagenet")
     out = capsys.readouterr().out
     assert load_classes("imagenet")[3] in out
+
+
+# --- missing weights are loud (VERDICT r1 #6) ------------------------------
+
+def test_missing_weights_is_hard_error(sample_video, tmp_path):
+    """No --weights_path -> RuntimeError naming what was expected; the
+    reference never silently runs random weights (ref extract_i3d.py:23-26)."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    cfg = ExtractionConfig(
+        feature_type="i3d",
+        video_paths=[sample_video],
+        streams=["rgb"],
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    ex = ExtractI3D(cfg, external_call=True)
+    with pytest.raises(RuntimeError, match=r"i3d\[rgb\].*i3d_rgb\.pt"):
+        ex([0])
+
+
+def test_incomplete_weights_dir_is_hard_error(sample_video, tmp_path):
+    """A --weights_path directory missing one stream's file names the
+    exact absent file."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    wdir = tmp_path / "weights"
+    wdir.mkdir()
+    cfg = ExtractionConfig(
+        feature_type="i3d",
+        video_paths=[sample_video],
+        streams=["rgb"],
+        weights_path=str(wdir),
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    ex = ExtractI3D(cfg, external_call=True)
+    with pytest.raises(RuntimeError, match="i3d_rgb.pt"):
+        ex([0])
+
+
+def test_sparse_seek_decode_matches_sequential(tmp_path):
+    """The sparse random-access path of read_frames_at_indices must return
+    bit-identical frames to a sequential decode (seek accuracy check)."""
+    from video_features_tpu.io.video import read_frames_at_indices
+    from video_features_tpu.utils.synth import synth_video
+
+    video = synth_video(str(tmp_path / "long.mp4"), n_frames=200, width=64, height=48)
+    sparse_ix = [3, 50, 120, 199]  # 4*8 < 200 -> seek path
+    sparse = read_frames_at_indices(video, sparse_ix)
+    dense = read_frames_at_indices(video, list(range(200)))  # sequential path
+    assert sorted(sparse) == sparse_ix
+    for i in sparse_ix:
+        np.testing.assert_array_equal(sparse[i], dense[i])
+
+
+def test_flow_quantize_boundary_no_uint8_wrap():
+    """At exactly +bound the reference formula gives 256.0; the storage
+    quantizer must clip to 255, not wrap to 0."""
+    from video_features_tpu.ops.preprocess import flow_quantize_uint8_np
+
+    q = flow_quantize_uint8_np(np.array([-25.0, -20.0, 0.0, 20.0, 25.0]))
+    np.testing.assert_array_equal(q, [0, 0, 128, 255, 255])
+    assert q.dtype == np.uint8

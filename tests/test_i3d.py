@@ -1,129 +1,19 @@
-"""I3D parity vs a torch oracle + end-to-end extraction.
+"""I3D transforms + end-to-end extraction.
 
-The oracle is a compact torch reimplementation of the reference I3D
-(TF-style asymmetric SAME padding, ceil-mode zero-padded max pools) with
-state-dict-compatible names (conv3d_*.conv3d/batch3d, mixed_*.branch_*,
-conv3d_0c_1x1) — random weights AND random BN stats.
+Model parity lives in tests/test_reference_parity.py, which oracles
+against the actual reference source (/root/reference/models/i3d/
+i3d_src/i3d_net.py) at the real 64-frame stack size — the round-1
+builder-written torch mirror was deleted in its favor.
 """
 
 import numpy as np
 import pytest
 import torch
-import torch.nn.functional as F
-from torch import nn
 
 import jax.numpy as jnp
 
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.models.i3d.convert import convert_state_dict
-from video_features_tpu.models.i3d.model import build, tf_same_pads
-
-
-def _fpad(kernel, stride):
-    # F.pad wants (wl, wr, ht, hb, dt, db)
-    (dt, db), (ht, hb), (wl, wr) = tf_same_pads(kernel, stride)
-    return (wl, wr, ht, hb, dt, db)
-
-
-class TUnit(nn.Module):
-    def __init__(self, i, o, k=(1, 1, 1), s=(1, 1, 1), bn=True, bias=False, act=True):
-        super().__init__()
-        self.pad = _fpad(k, s)
-        self.conv3d = nn.Conv3d(i, o, k, s, bias=bias)
-        self.bn, self.act = bn, act
-        if bn:
-            self.batch3d = nn.BatchNorm3d(o)
-
-    def forward(self, x):
-        x = self.conv3d(F.pad(x, self.pad))
-        if self.bn:
-            x = self.batch3d(x)
-        return torch.relu(x) if self.act else x
-
-
-class TPool(nn.Module):
-    def __init__(self, k, s):
-        super().__init__()
-        self.pad, self.k, self.s = _fpad(k, s), k, s
-
-    def forward(self, x):
-        return F.max_pool3d(F.pad(x, self.pad), self.k, self.s, ceil_mode=True)
-
-
-class TMixed(nn.Module):
-    def __init__(self, i, o):
-        super().__init__()
-        self.branch_0 = TUnit(i, o[0])
-        self.branch_1 = nn.Sequential(TUnit(i, o[1]), TUnit(o[1], o[2], (3, 3, 3)))
-        self.branch_2 = nn.Sequential(TUnit(i, o[3]), TUnit(o[3], o[4], (3, 3, 3)))
-        self.branch_3 = nn.Sequential(TPool((3, 3, 3), (1, 1, 1)), TUnit(i, o[5]))
-
-    def forward(self, x):
-        return torch.cat(
-            [self.branch_0(x), self.branch_1(x), self.branch_2(x), self.branch_3(x)], 1
-        )
-
-
-class TI3D(nn.Module):
-    def __init__(self, in_ch=3, classes=400):
-        super().__init__()
-        self.conv3d_1a_7x7 = TUnit(in_ch, 64, (7, 7, 7), (2, 2, 2))
-        self.pool_2a = TPool((1, 3, 3), (1, 2, 2))
-        self.conv3d_2b_1x1 = TUnit(64, 64)
-        self.conv3d_2c_3x3 = TUnit(64, 192, (3, 3, 3))
-        self.pool_3a = TPool((1, 3, 3), (1, 2, 2))
-        self.mixed_3b = TMixed(192, [64, 96, 128, 16, 32, 32])
-        self.mixed_3c = TMixed(256, [128, 128, 192, 32, 96, 64])
-        self.pool_4a = TPool((3, 3, 3), (2, 2, 2))
-        self.mixed_4b = TMixed(480, [192, 96, 208, 16, 48, 64])
-        self.mixed_4c = TMixed(512, [160, 112, 224, 24, 64, 64])
-        self.mixed_4d = TMixed(512, [128, 128, 256, 24, 64, 64])
-        self.mixed_4e = TMixed(512, [112, 144, 288, 32, 64, 64])
-        self.mixed_4f = TMixed(528, [256, 160, 320, 32, 128, 128])
-        self.pool_5a = TPool((2, 2, 2), (2, 2, 2))
-        self.mixed_5b = TMixed(832, [256, 160, 320, 32, 128, 128])
-        self.mixed_5c = TMixed(832, [384, 192, 384, 48, 128, 128])
-        self.conv3d_0c_1x1 = TUnit(1024, classes, bn=False, bias=True, act=False)
-
-    def forward(self, x):
-        x = self.pool_2a(self.conv3d_1a_7x7(x))
-        x = self.pool_3a(self.conv3d_2c_3x3(self.conv3d_2b_1x1(x)))
-        x = self.mixed_3c(self.mixed_3b(x))
-        x = self.pool_4a(x)
-        x = self.mixed_4f(self.mixed_4e(self.mixed_4d(self.mixed_4c(self.mixed_4b(x)))))
-        x = self.pool_5a(x)
-        x = self.mixed_5c(self.mixed_5b(x))
-        x = F.avg_pool3d(x, (2, 7, 7), (1, 1, 1))
-        feats = x.mean(dim=(2, 3, 4))
-        logits = self.conv3d_0c_1x1(x).mean(dim=(2, 3, 4))
-        return feats, logits
-
-
-def _torch_oracle(in_ch=3, seed=0):
-    torch.manual_seed(seed)
-    model = TI3D(in_ch)
-    with torch.no_grad():
-        for m in model.modules():
-            if isinstance(m, nn.BatchNorm3d):
-                m.running_mean.normal_(0, 0.3)
-                m.running_var.uniform_(0.5, 2.0)
-    model.eval()
-    return model
-
-
-@pytest.mark.parametrize("in_ch", [3, 2])
-def test_i3d_matches_torch_oracle(in_ch):
-    oracle = _torch_oracle(in_ch)
-    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
-    params = convert_state_dict(sd)
-
-    rng = np.random.RandomState(0)
-    x = rng.uniform(-1, 1, size=(1, 10, 224, 224, in_ch)).astype(np.float32)
-    with torch.no_grad():
-        ref_f, ref_l = oracle(torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3))))
-    feats, logits = build().apply({"params": params}, jnp.asarray(x))
-    np.testing.assert_allclose(np.asarray(feats), ref_f.numpy(), atol=2e-4)
-    np.testing.assert_allclose(np.asarray(logits), ref_l.numpy(), atol=2e-4)
 
 
 def test_flow_transform_chain_matches_torch():
@@ -150,7 +40,11 @@ def test_flow_transform_chain_matches_torch():
 
 
 def test_converter_rejects_unconsumed():
-    sd = {k: v.numpy() for k, v in _torch_oracle().state_dict().items()}
+    from test_reference_parity import _ref_import
+
+    i3d_mod = _ref_import("models.i3d.i3d_src.i3d_net")
+    torch.manual_seed(0)
+    sd = {k: v.numpy() for k, v in i3d_mod.I3D(400).state_dict().items()}
     sd["stray.weight"] = np.zeros(3, np.float32)
     with pytest.raises(ValueError, match="unconsumed"):
         convert_state_dict(sd)
@@ -160,6 +54,7 @@ def test_extract_i3d_rgb_end_to_end(sample_video, tmp_path):
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="i3d",
         video_paths=[sample_video],
         streams=["rgb"],
@@ -197,6 +92,7 @@ def test_extract_i3d_precomputed_flow(sample_video, tmp_path):
             cv2.imwrite(str(flow_dir / f"flow_{axis}_{i:05d}.jpg"), img)
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="i3d",
         video_paths=[sample_video],
         flow_paths=[str(flow_dir)],
@@ -220,6 +116,7 @@ def test_extract_i3d_two_stream_pwc(sample_video, tmp_path):
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="i3d",
         video_paths=[sample_video],
         flow_type="pwc",
@@ -237,3 +134,67 @@ def test_extract_i3d_two_stream_pwc(sample_video, tmp_path):
     assert np.isfinite(out["rgb"]).all() and np.isfinite(out["flow"]).all()
     # fps in the output dict is the SOURCE fps (ref extract_i3d.py:240)
     assert float(out["fps"]) == 25.0
+
+
+def test_flow_roundtrip_save_jpg_matches_on_the_fly(tmp_path):
+    """The reference workflow 'extract flow -> save jpgs -> i3d
+    --flow_type flow' (ref utils/utils.py:98-110 + extract_i3d.py:195-229),
+    driveable end-to-end here: standalone PWC writes quantized flow JPEGs
+    via --on_extraction save_jpg, and i3d consumes them, matching the
+    on-the-fly pwc-flow features within the uint8-quantization + JPEG
+    budget. RAFT shares the identical save/load path."""
+    import pathlib
+
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+    from video_features_tpu.utils.synth import synth_video
+
+    # >=65 frames dodges the upsample-to-65 quirk so the standalone
+    # extractor and i3d see the same frame grid; 128px source upscales to
+    # the same 256x256 in both (pil_resize, side 256)
+    video = synth_video(
+        str(tmp_path / "rt.mp4"), n_frames=65, width=128, height=128
+    )
+
+    pwc_cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="pwc",
+        video_paths=[video],
+        batch_size=8,
+        side_size=256,
+        on_extraction="save_jpg",
+        output_path=str(tmp_path / "flowjpg"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    ExtractPWC(pwc_cfg)([0])
+    flow_dir = pathlib.Path(tmp_path / "flowjpg" / "pwc" / "rt")
+    assert len(list(flow_dir.glob("flow_x_*.jpg"))) == 64
+
+    common = dict(
+        allow_random_init=True,
+        feature_type="i3d",
+        streams=["flow"],
+        stack_size=10,
+        step_size=30,
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+    )
+    fly = ExtractI3D(
+        ExtractionConfig(video_paths=[video], flow_type="pwc", **common),
+        external_call=True,
+    )([0])[0]["flow"]
+    disk = ExtractI3D(
+        ExtractionConfig(
+            video_paths=[video],
+            flow_paths=[str(flow_dir)],
+            flow_type="flow",
+            **common,
+        ),
+        external_call=True,
+    )([0])[0]["flow"]
+
+    assert fly.shape == disk.shape == (2, 1024)
+    rel = np.linalg.norm(fly - disk) / max(np.linalg.norm(fly), 1e-12)
+    assert rel < 0.05, f"round-trip relative L2 {rel}"

@@ -57,6 +57,7 @@ def test_extract_resnet_native_preprocess(sample_video, tmp_path):
     from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="resnet18",
         video_paths=[sample_video],
         extraction_fps=2.0,

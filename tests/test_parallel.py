@@ -46,6 +46,7 @@ def test_parallel_extraction_covers_all_videos(sample_video, tmp_path):
         videos.append(str(dst))
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="resnet18",
         video_paths=videos,
         extraction_fps=2.0,
@@ -110,3 +111,86 @@ def test_graft_dryrun_multichip():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+# --- fault tolerance (VERDICT r1 #8) ---------------------------------------
+
+class _FakeExtractor:
+    """Duck-typed extractor whose __call__ can die OUTSIDE the per-video
+    isolation that real extractors provide — simulating a warmup-adjacent
+    escape (OOM, sink failure) that kills the worker thread."""
+
+    def __init__(self, n, die_on_device=None):
+        import threading
+        from tqdm import tqdm
+
+        self.path_list = list(range(n))
+        self.config = ExtractionConfig(allow_random_init=True)
+        self.progress = tqdm(total=n, disable=True)
+        self.done = []
+        self.die_on_device = die_on_device
+        self._lock = threading.Lock()
+        self._died = False
+
+    def warmup(self, device):
+        return None
+
+    def __call__(self, indices, device=None):
+        with self._lock:
+            if (
+                self.die_on_device is not None
+                and device.id == self.die_on_device
+                and not self._died
+            ):
+                self._died = True
+                raise RuntimeError("boom: escape past per-video isolation")
+            self.done.extend(int(i) for i in indices)
+        import time
+
+        time.sleep(0.02)  # keep the queue alive until the dying worker pulls
+
+
+def test_worker_death_requeues_in_flight_item(capsys):
+    """A worker that dies holding an item must not lose it: the item is
+    re-queued and completed by the surviving workers, and the run says so."""
+    ex = _FakeExtractor(8, die_on_device=1)
+    parallel_feature_extraction(ex, jax.devices()[:2])
+    assert sorted(ex.done) == list(range(8))
+    assert "died mid-run" in capsys.readouterr().out
+
+
+def test_all_workers_dead_raises():
+    class AlwaysDies(_FakeExtractor):
+        def __call__(self, indices, device=None):
+            raise RuntimeError("boom")
+
+    ex = AlwaysDies(4)
+    with pytest.raises(RuntimeError, match="unprocessed"):
+        parallel_feature_extraction(ex, jax.devices()[:2])
+
+
+def test_decode_workers_pipeline_outputs_identical(sample_video, tmp_path):
+    """The async host pipeline (--decode_workers) must be a pure
+    scheduling change: features bit-identical to the serial path."""
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    def run(workers):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="resnet18",
+            video_paths=[sample_video] * 3,
+            extraction_fps=2.0,
+            batch_size=4,
+            decode_workers=workers,
+            cpu=True,
+        )
+        ex = ExtractResNet(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex(range(3))
+
+    serial = run(0)   # decode_workers=0 disables the pipeline
+    piped = run(3)
+    assert len(serial) == len(piped) == 3
+    for s, p in zip(serial, piped):
+        np.testing.assert_array_equal(s["resnet18"], p["resnet18"])
+        np.testing.assert_array_equal(s["timestamps_ms"], p["timestamps_ms"])

@@ -148,6 +148,7 @@ def test_extract_r21d_end_to_end(sample_video, tmp_path):
     from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="r21d_rgb",
         video_paths=[sample_video],
         on_extraction="save_numpy",
@@ -171,6 +172,7 @@ def test_extract_r21d_show_pred(sample_video, tmp_path, capsys):
     from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="r21d_rgb",
         video_paths=[sample_video],
         stack_size=32,

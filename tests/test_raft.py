@@ -1,201 +1,26 @@
-"""RAFT parity vs a torch oracle + end-to-end extraction.
+"""RAFT runtime pieces + end-to-end extraction.
 
-The oracle is a compact torch reimplementation of princeton-vl RAFT
-(basic config) with state-dict-compatible parameter names (fnet/cnet
-BasicEncoder: conv1, norm1, layer{1..3}.{0,1}.*, downsample.{0,1}, conv2;
-update_block.{encoder,gru,flow_head,mask}) — random weights and random
-cnet BN running stats so the converter plumbing is exercised.
+Model parity lives in tests/test_reference_parity.py, which oracles
+against the actual reference source (/root/reference/models/raft/
+raft_src/raft.py) at full width — the round-1 builder-written torch
+mirror was deleted in its favor.
 """
 
 import numpy as np
 import pytest
 import torch
-import torch.nn.functional as F
-from torch import nn
-
-import jax.numpy as jnp
 
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.models.raft.convert import convert_state_dict
 from video_features_tpu.models.raft.extract_raft import InputPadder
-from video_features_tpu.models.raft.model import build
-
-
-def _norm(kind, ch):
-    return nn.BatchNorm2d(ch) if kind == "batch" else nn.InstanceNorm2d(ch)
-
-
-class TorchResBlock(nn.Module):
-    def __init__(self, inp, planes, norm, stride=1):
-        super().__init__()
-        self.conv1 = nn.Conv2d(inp, planes, 3, stride, 1)
-        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1)
-        self.norm1 = _norm(norm, planes)
-        self.norm2 = _norm(norm, planes)
-        self.downsample = None
-        if stride != 1:
-            self.downsample = nn.Sequential(
-                nn.Conv2d(inp, planes, 1, stride), _norm(norm, planes)
-            )
-
-    def forward(self, x):
-        y = torch.relu(self.norm1(self.conv1(x)))
-        y = torch.relu(self.norm2(self.conv2(y)))
-        if self.downsample is not None:
-            x = self.downsample(x)
-        return torch.relu(x + y)
-
-
-class TorchEncoder(nn.Module):
-    def __init__(self, out_dim, norm):
-        super().__init__()
-        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3)
-        self.norm1 = _norm(norm, 64)
-        self.layer1 = nn.Sequential(
-            TorchResBlock(64, 64, norm), TorchResBlock(64, 64, norm)
-        )
-        self.layer2 = nn.Sequential(
-            TorchResBlock(64, 96, norm, 2), TorchResBlock(96, 96, norm)
-        )
-        self.layer3 = nn.Sequential(
-            TorchResBlock(96, 128, norm, 2), TorchResBlock(128, 128, norm)
-        )
-        self.conv2 = nn.Conv2d(128, out_dim, 1)
-
-    def forward(self, x):
-        x = torch.relu(self.norm1(self.conv1(x)))
-        return self.conv2(self.layer3(self.layer2(self.layer1(x))))
-
-
-class TorchUpdateBlock(nn.Module):
-    def __init__(self):
-        super().__init__()
-        enc = nn.Module()
-        enc.convc1 = nn.Conv2d(4 * 81, 256, 1)
-        enc.convc2 = nn.Conv2d(256, 192, 3, padding=1)
-        enc.convf1 = nn.Conv2d(2, 128, 7, padding=3)
-        enc.convf2 = nn.Conv2d(128, 64, 3, padding=1)
-        enc.conv = nn.Conv2d(256, 126, 3, padding=1)
-        self.encoder = enc
-        gru = nn.Module()
-        for s, k, p in (("1", (1, 5), (0, 2)), ("2", (5, 1), (2, 0))):
-            for g in "zrq":
-                setattr(gru, f"conv{g}{s}", nn.Conv2d(384, 128, k, padding=p))
-        self.gru = gru
-        fh = nn.Module()
-        fh.conv1 = nn.Conv2d(128, 256, 3, padding=1)
-        fh.conv2 = nn.Conv2d(256, 2, 3, padding=1)
-        self.flow_head = fh
-        self.mask = nn.Sequential(
-            nn.Conv2d(128, 256, 3, padding=1), nn.ReLU(), nn.Conv2d(256, 576, 1)
-        )
-
-    def forward(self, net, inp, corr, flow):
-        e = self.encoder
-        cor = torch.relu(e.convc2(torch.relu(e.convc1(corr))))
-        flo = torch.relu(e.convf2(torch.relu(e.convf1(flow))))
-        motion = torch.cat([torch.relu(e.conv(torch.cat([cor, flo], 1))), flow], 1)
-        x = torch.cat([inp, motion], 1)
-        g = self.gru
-        for s in ("1", "2"):
-            hx = torch.cat([net, x], 1)
-            z = torch.sigmoid(getattr(g, f"convz{s}")(hx))
-            r = torch.sigmoid(getattr(g, f"convr{s}")(hx))
-            q = torch.tanh(getattr(g, f"convq{s}")(torch.cat([r * net, x], 1)))
-            net = (1 - z) * net + z * q
-        delta = self.flow_head.conv2(torch.relu(self.flow_head.conv1(net)))
-        return net, 0.25 * self.mask(net), delta
-
-
-def _sample(img, coords):
-    H, W = img.shape[-2:]
-    xg = 2 * coords[..., 0] / (W - 1) - 1
-    yg = 2 * coords[..., 1] / (H - 1) - 1
-    return F.grid_sample(img, torch.stack([xg, yg], -1), align_corners=True)
-
-
-class TorchRAFT(nn.Module):
-    def __init__(self):
-        super().__init__()
-        self.fnet = TorchEncoder(256, "instance")
-        self.cnet = TorchEncoder(256, "batch")
-        self.update_block = TorchUpdateBlock()
-
-    def forward(self, image1, image2, iters):
-        i1 = 2 * (image1 / 255.0) - 1
-        i2 = 2 * (image2 / 255.0) - 1
-        f1, f2 = self.fnet(i1), self.fnet(i2)
-        B, C, H, W = f1.shape
-        corr = torch.matmul(
-            f1.view(B, C, H * W).transpose(1, 2), f2.view(B, C, H * W)
-        ) / C ** 0.5
-        pyr = [corr.view(B * H * W, 1, H, W)]
-        for _ in range(3):
-            pyr.append(F.avg_pool2d(pyr[-1], 2, 2))
-
-        def corr_fn(coords):
-            coords = coords.permute(0, 2, 3, 1)
-            d = torch.linspace(-4, 4, 9)
-            delta = torch.stack(torch.meshgrid(d, d, indexing="ij"), -1)
-            out = []
-            for i, c in enumerate(pyr):
-                cl = coords.reshape(B * H * W, 1, 1, 2) / 2 ** i + delta.view(1, 9, 9, 2)
-                out.append(_sample(c, cl).view(B, H, W, 81))
-            return torch.cat(out, -1).permute(0, 3, 1, 2)
-
-        cnet = self.cnet(i1)
-        net, inp = torch.split(cnet, [128, 128], dim=1)
-        net, inp = torch.tanh(net), torch.relu(inp)
-        yy, xx = torch.meshgrid(
-            torch.arange(H).float(), torch.arange(W).float(), indexing="ij"
-        )
-        coords0 = torch.stack([xx, yy], 0)[None].repeat(B, 1, 1, 1)
-        coords1 = coords0.clone()
-        for _ in range(iters):
-            corr = corr_fn(coords1)
-            net, mask, delta = self.update_block(net, inp, corr, coords1 - coords0)
-            coords1 = coords1 + delta
-        flow = coords1 - coords0
-        mask = torch.softmax(mask.view(B, 1, 9, 8, 8, H, W), dim=2)
-        up = F.unfold(8 * flow, [3, 3], padding=1).view(B, 2, 9, 1, 1, H, W)
-        up = torch.sum(mask * up, dim=2).permute(0, 1, 4, 2, 5, 3)
-        return up.reshape(B, 2, 8 * H, 8 * W)
-
-
-def _torch_oracle(seed=0):
-    torch.manual_seed(seed)
-    model = TorchRAFT()
-    with torch.no_grad():
-        for m in model.modules():
-            if isinstance(m, nn.BatchNorm2d):
-                m.running_mean.normal_(0, 0.3)
-                m.running_var.uniform_(0.5, 2.0)
-    model.eval()
-    return model
-
-
-def test_raft_matches_torch_oracle():
-    oracle = _torch_oracle()
-    sd = {f"module.{k}": v.numpy() for k, v in oracle.state_dict().items()}
-    params = convert_state_dict(sd)
-
-    rng = np.random.RandomState(0)
-    # >=128 px per dim: below that the deepest pyramid level is 1x1 and
-    # the (reference-identical) sampler math produces NaN
-    frames = rng.uniform(0, 255, size=(3, 128, 128, 3)).astype(np.float32)
-    t = torch.from_numpy(np.transpose(frames, (0, 3, 1, 2)))
-    with torch.no_grad():
-        ref = oracle(t[:-1], t[1:], iters=4).numpy()
-
-    flow = build(iters=4).apply({"params": params}, jnp.asarray(frames))
-    flow = np.transpose(np.asarray(flow), (0, 3, 1, 2))
-    assert flow.shape == ref.shape == (2, 2, 128, 128)
-    assert np.isfinite(ref).all() and np.isfinite(flow).all()
-    np.testing.assert_allclose(flow, ref, atol=1e-3, rtol=1e-4)
 
 
 def test_converter_rejects_unconsumed():
-    sd = {f"module.{k}": v.numpy() for k, v in _torch_oracle().state_dict().items()}
+    from test_reference_parity import _ref_import
+
+    raft_mod = _ref_import("models.raft.raft_src.raft")
+    torch.manual_seed(0)
+    sd = {f"module.{k}": v.numpy() for k, v in raft_mod.RAFT().state_dict().items()}
     sd["module.stray.weight"] = np.zeros(3, np.float32)
     with pytest.raises(ValueError, match="unconsumed"):
         convert_state_dict(sd)
@@ -224,6 +49,7 @@ def test_extract_raft_end_to_end(sample_video, tmp_path):
     from video_features_tpu.models.raft.extract_raft import ExtractRAFT
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="raft",
         video_paths=[sample_video],
         extraction_fps=5.0,  # 60-frame 25fps synth clip -> 12 frames

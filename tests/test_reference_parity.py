@@ -1,0 +1,311 @@
+"""Parity against the ACTUAL reference sources in /root/reference.
+
+The round-1 parity suites oracled against builder-written torch
+reimplementations, which could share a misreading with the Flax port.
+These tests import the reference code itself and assert parity at full
+model width on realistic input shapes:
+
+- RAFT:   /root/reference/models/raft/raft_src/raft.py (pure torch)
+- I3D:    /root/reference/models/i3d/i3d_src/i3d_net.py (pure torch),
+          rgb AND flow modalities
+- PWC:    /root/reference/models/pwc/pwc_src/pwc_net.py with its cupy-only
+          FunctionCorrelation monkeypatched by ops.correlation
+          .local_correlation (itself validated against a naive
+          implementation in tests/test_ops.py::test_local_correlation_matches_naive)
+- VGGish: /root/reference/models/vggish/vggish_src/mel_features.py and
+          vggish_postprocess.py (pure NumPy, loaded standalone — only
+          vggish_input.py's resampy import is blocked in this env)
+
+The reference tree has no __init__.py files; with /root/reference appended
+to sys.path its ``models.*`` imports resolve as implicit namespace
+packages. torchvision and the pip ``clip`` package are NOT in this env, so
+ResNet/R21D keep their torchvision-format builder oracles
+(tests/test_resnet.py, tests/test_r21d.py) and CLIP's independent oracle
+is transformers' CLIPVisionModelWithProjection — exercised at full
+ViT-B/32 width here (round 1 covered only a toy config).
+"""
+
+import importlib
+import importlib.util
+import sys
+import types
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+REF = "/root/reference"
+
+
+def _ref_import(name: str):
+    """Import ``models.*`` from the reference tree as namespace packages."""
+    if REF not in sys.path:
+        sys.path.append(REF)  # append: never shadow repo/stdlib names
+    return importlib.import_module(name)
+
+
+def _load_standalone(mod_name: str, rel_path: str):
+    """Load one reference file by path, without triggering sibling imports."""
+    spec = importlib.util.spec_from_file_location(mod_name, f"{REF}/{rel_path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _randomize_bn_stats(model: torch.nn.Module, seed: int = 7) -> None:
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, (torch.nn.BatchNorm2d, torch.nn.BatchNorm3d)):
+                m.running_mean.normal_(0, 0.3, generator=g)
+                m.running_var.uniform_(0.5, 2.0, generator=g)
+
+
+# --- RAFT -------------------------------------------------------------------
+
+
+def test_raft_matches_reference_source():
+    """Full-width RAFT (256-d encoders, 12 GRU iters) vs raft_src/raft.py."""
+    from video_features_tpu.models.raft.convert import convert_state_dict
+    from video_features_tpu.models.raft.model import build
+
+    raft_mod = _ref_import("models.raft.raft_src.raft")
+    torch.manual_seed(0)
+    oracle = raft_mod.RAFT()
+    _randomize_bn_stats(oracle)
+    oracle.eval()
+
+    # checkpoint convention: DataParallel 'module.' prefix (ref
+    # models/raft/extract_raft.py:59)
+    sd = {f"module.{k}": v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd)
+
+    rng = np.random.RandomState(0)
+    frames = rng.uniform(0, 255, size=(3, 160, 224, 3)).astype(np.float32)
+    t = torch.from_numpy(np.transpose(frames, (0, 3, 1, 2)))
+    with torch.no_grad():
+        ref = oracle(t[:-1], t[1:], iters=12, test_mode=True).numpy()
+
+    flow = build(iters=12).apply({"params": params}, jnp.asarray(frames))
+    flow = np.transpose(np.asarray(flow), (0, 3, 1, 2))
+    assert flow.shape == ref.shape == (2, 2, 160, 224)
+    assert np.isfinite(ref).all() and np.isfinite(flow).all()
+    # L2 budget (BASELINE.md): well under 1e-3 relative
+    l2 = np.linalg.norm(flow - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 <= 1e-3, f"relative L2 {l2}"
+    np.testing.assert_allclose(flow, ref, atol=5e-3, rtol=1e-4)
+
+
+# --- I3D --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("modality,t_frames", [("rgb", 64), ("flow", 16)])
+def test_i3d_matches_reference_source(modality, t_frames):
+    """Full I3D vs i3d_src/i3d_net.py, rgb at the real 64-frame stack size."""
+    from video_features_tpu.models.i3d.convert import convert_state_dict
+    from video_features_tpu.models.i3d.model import build
+
+    i3d_mod = _ref_import("models.i3d.i3d_src.i3d_net")
+    torch.manual_seed(0)
+    oracle = i3d_mod.I3D(num_classes=400, modality=modality)
+    _randomize_bn_stats(oracle)
+    oracle.eval()
+
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd)
+
+    in_ch = 3 if modality == "rgb" else 2
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, size=(1, t_frames, 224, 224, in_ch)).astype(np.float32)
+    xt = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))
+    with torch.no_grad():
+        ref_feats = oracle(xt, features=True).numpy()
+        _, ref_logits = oracle(xt, features=False)
+        ref_logits = ref_logits.numpy()
+
+    feats, logits = build().apply({"params": params}, jnp.asarray(x))
+    assert np.asarray(feats).shape == ref_feats.shape == (1, 1024)
+    np.testing.assert_allclose(np.asarray(feats), ref_feats, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, atol=5e-4)
+
+
+# --- PWC-Net ----------------------------------------------------------------
+
+
+def _load_reference_pwc():
+    """Import pwc_src/pwc_net.py with its cupy correlation monkeypatched.
+
+    The reference kernel (pwc_src/correlation.py:287-397) is CUDA-only; the
+    stub routes through our XLA formulation, which tests/test_ops.py
+    validates against a naive implementation independently. pwc_net.py also
+    asserts on a torch<2 version-string format at import (pwc_net.py:21),
+    patched around for the duration of the import only.
+    """
+    from video_features_tpu.ops.correlation import local_correlation
+
+    name = "models.pwc.pwc_src.pwc_net"
+    if name in sys.modules:
+        return sys.modules[name]
+
+    def fn_correlation(tensorFirst, tensorSecond, device=None):
+        out = local_correlation(
+            jnp.asarray(tensorFirst.detach().numpy()),
+            jnp.asarray(tensorSecond.detach().numpy()),
+            method="xla",
+        )
+        return torch.from_numpy(np.asarray(out))
+
+    stub = types.ModuleType("models.pwc.pwc_src.correlation")
+    stub.FunctionCorrelation = fn_correlation
+    # parent namespace packages must exist before the submodule import
+    _ref_import("models.pwc.pwc_src")
+    sys.modules["models.pwc.pwc_src.correlation"] = stub
+    real_ver = torch.__version__
+    try:
+        torch.__version__ = "1.6.0"
+        return _ref_import(name)
+    finally:
+        torch.__version__ = real_ver
+
+
+def test_pwc_matches_reference_source():
+    from video_features_tpu.models.pwc.convert import convert_state_dict
+    from video_features_tpu.models.pwc.model import build
+
+    pwc_mod = _load_reference_pwc()
+    torch.manual_seed(0)
+    oracle = pwc_mod.PWCNet()
+    oracle.eval()
+
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd)
+
+    rng = np.random.RandomState(0)
+    frames = rng.uniform(0, 255, size=(3, 128, 192, 3)).astype(np.float32)
+    t = torch.from_numpy(np.transpose(frames, (0, 3, 1, 2)))
+    with torch.no_grad():
+        ref = oracle(t[:-1], t[1:]).numpy()
+
+    flow = build().apply({"params": params}, jnp.asarray(frames))
+    flow = np.transpose(np.asarray(flow), (0, 3, 1, 2))
+    assert flow.shape == ref.shape == (2, 2, 128, 192)
+    assert np.isfinite(ref).all() and np.isfinite(flow).all()
+    np.testing.assert_allclose(flow, ref, atol=1e-3, rtol=1e-4)
+
+
+# --- VGGish frontend + postprocessor ---------------------------------------
+
+
+def test_log_mel_matches_reference_source():
+    """mel.waveform_to_examples vs the reference NumPy pipeline
+    (mel_features.log_mel_spectrogram + the example framing of
+    vggish_input.py:44-64, reproduced with reference constants since
+    vggish_input.py itself imports resampy at module scope)."""
+    from video_features_tpu.models.vggish import mel
+
+    ref_params = _load_standalone(
+        "ref_vggish_params", "models/vggish/vggish_src/vggish_params.py"
+    )
+    ref_mel = _load_standalone(
+        "ref_mel_features", "models/vggish/vggish_src/mel_features.py"
+    )
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, size=int(16000 * 2.5)).astype(np.float64)
+
+    lm = ref_mel.log_mel_spectrogram(
+        data,
+        audio_sample_rate=ref_params.SAMPLE_RATE,
+        log_offset=ref_params.LOG_OFFSET,
+        window_length_secs=ref_params.STFT_WINDOW_LENGTH_SECONDS,
+        hop_length_secs=ref_params.STFT_HOP_LENGTH_SECONDS,
+        num_mel_bins=ref_params.NUM_MEL_BINS,
+        lower_edge_hertz=ref_params.MEL_MIN_HZ,
+        upper_edge_hertz=ref_params.MEL_MAX_HZ,
+    )
+    feats_rate = 1.0 / ref_params.STFT_HOP_LENGTH_SECONDS
+    win = int(round(ref_params.EXAMPLE_WINDOW_SECONDS * feats_rate))
+    hop = int(round(ref_params.EXAMPLE_HOP_SECONDS * feats_rate))
+    ref_examples = ref_mel.frame(lm, window_length=win, hop_length=hop)
+
+    ours = mel.waveform_to_examples(data, ref_params.SAMPLE_RATE)
+    assert ours.shape == ref_examples.shape == (2, 96, 64)
+    np.testing.assert_allclose(ours, ref_examples, atol=1e-6)
+
+
+def test_pca_postprocess_matches_reference_source():
+    from video_features_tpu.models.vggish.model import postprocess
+
+    # vggish_postprocess imports vggish_params via the models.* namespace
+    _ref_import("models.vggish.vggish_src")
+    ref_pp = _ref_import("models.vggish.vggish_src.vggish_postprocess")
+
+    rng = np.random.RandomState(0)
+    means = rng.randn(128, 1).astype(np.float64)
+    # a random orthonormal-ish PCA matrix
+    eigen = np.linalg.qr(rng.randn(128, 128))[0].astype(np.float64)
+    emb = rng.randn(5, 128).astype(np.float32)
+
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "pca.npz")
+        np.savez(path, pca_eigen_vectors=eigen, pca_means=means)
+        oracle = ref_pp.Postprocessor(path)
+        ref_out = oracle.postprocess(emb.astype(np.float64))
+
+    ours = np.asarray(
+        postprocess(
+            jnp.asarray(emb),
+            {"pca_eigen_vectors": jnp.asarray(eigen, jnp.float32),
+             "pca_means": jnp.asarray(means.reshape(-1), jnp.float32)},
+        )
+    )
+    assert ours.shape == ref_out.shape == (5, 128)
+    assert ours.dtype == np.uint8 and ref_out.dtype == np.uint8
+    # fp32 vs fp64 matmul can flip a value sitting exactly on a rounding
+    # boundary by 1 quantization step
+    assert np.abs(ours.astype(int) - ref_out.astype(int)).max() <= 1
+
+
+# --- CLIP at full ViT-B/32 width (independent transformers oracle) ---------
+
+
+def test_clip_full_width_matches_hf_oracle():
+    """Round 1 proved the graph at a toy config; this runs the real
+    ViT-B/32 (12 layers, width 768, 12 heads, 224px) through the HF
+    converter — transformers' implementation is an independent codebase,
+    not builder-written."""
+    from transformers import CLIPVisionConfig as HFConfig
+    from transformers import CLIPVisionModelWithProjection
+
+    from video_features_tpu.models.clip.convert import from_hf_vision
+    from video_features_tpu.models.clip.model import CLIP_VIT_B32, VisionTransformer
+
+    hf_cfg = HFConfig(
+        hidden_size=768,
+        intermediate_size=3072,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        image_size=224,
+        patch_size=32,
+        projection_dim=512,
+        hidden_act="quick_gelu",
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    oracle = CLIPVisionModelWithProjection(hf_cfg)
+    oracle.eval()
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = from_hf_vision(sd, layers=12)
+
+    x = np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32)
+    with torch.no_grad():
+        ref = oracle(pixel_values=torch.from_numpy(x)).image_embeds.numpy()
+    out = np.asarray(
+        VisionTransformer(CLIP_VIT_B32).apply({"params": params}, jnp.asarray(x))
+    )
+    assert out.shape == ref.shape == (2, 512)
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=1e-4)

@@ -159,6 +159,7 @@ def test_extract_resnet_end_to_end(sample_video, tmp_path):
     from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="resnet18",
         video_paths=[sample_video],
         extraction_fps=5.0,  # 60-frame 25fps synth clip -> 12 frames
@@ -184,6 +185,7 @@ def test_extract_resnet_show_pred(sample_video, tmp_path, capsys):
     from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="resnet18",
         video_paths=[sample_video],
         extraction_fps=1.0,

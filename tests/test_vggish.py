@@ -2,9 +2,11 @@
 postprocessor math, end-to-end wav extraction.
 
 The net oracle is a torch VGG with torchvggish state-dict names
-(features.{0,3,6,8,11,13}, embeddings.{0,2,4}); the frontend is checked
-by construction (shapes, silence, pure tones hitting the right mel band)
-since the reference's NumPy pipeline cannot be imported here.
+(features.{0,3,6,8,11,13}, embeddings.{0,2,4}); the frontend's property
+tests here (shapes, silence, pure tones hitting the right mel band) are
+complemented by tests/test_reference_parity.py, which checks the mel
+pipeline and the PCA postprocessor bit-for-bit against the reference's
+pure-NumPy sources (mel_features.py, vggish_postprocess.py).
 """
 
 import numpy as np
@@ -137,6 +139,7 @@ def test_extract_vggish_end_to_end(sample_wav, tmp_path):
     from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
 
     cfg = ExtractionConfig(
+        allow_random_init=True,
         feature_type="vggish",
         video_paths=[sample_wav],
         on_extraction="save_numpy",

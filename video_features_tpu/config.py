@@ -80,12 +80,24 @@ class ExtractionConfig:
 
     # --- TPU-native knobs (no reference equivalent) ---
     # Numerics: 'float32' for parity with the fp32 reference; 'bfloat16'
-    # for MXU throughput once parity is established.
+    # runs CLIP/ResNet/R21D/I3D conv+matmul stacks in bf16 (LayerNorm,
+    # softmax, BatchNorm math and the feature heads stay fp32; ~1e-2
+    # relative feature drift — tests/test_bfloat16.py). RAFT/PWC/VGGish
+    # intentionally ignore it (iterative flow refinement compounds drift).
     dtype: str = "float32"
-    # Path to converted model weights (.npz / orbax dir). None -> use
-    # deterministic random init (weights cannot be downloaded offline).
+    # Path to converted model weights (.npz / orbax dir). Absent or
+    # incomplete weights are a hard error unless allow_random_init is set
+    # (the reference either downloads weights or crashes —
+    # ref models/i3d/extract_i3d.py:23-26).
     weights_path: Optional[str] = None
-    # Host-side decode worker threads feeding each device queue.
+    # Escape hatch for tests/benchmarks: run with deterministic random
+    # init when weights are missing. Feature VALUES are then meaningless;
+    # only shapes/dtypes/pipeline behavior are exercised.
+    allow_random_init: bool = False
+    # Async host pipeline: decode/preprocess worker threads per device,
+    # prefetching upcoming videos' device-ready arrays while the current
+    # video computes (extract/base.py::_run_pipelined). 0 = fully serial
+    # decode->compute, the reference's behavior.
     decode_workers: int = 2
     # Host preprocessing backend for the PIL-chain extractors (currently
     # the ResNet family): 'pil' reproduces the reference bit-for-bit;
@@ -133,8 +145,13 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
     """Cross-field validation, mirroring ref utils/utils.py:129-150."""
     if os.path.relpath(cfg.output_path) == os.path.relpath(cfg.tmp_path):
         raise AssertionError("The same path for out & tmp")
-    if cfg.on_extraction not in ("print", "save_numpy", "save_pickle"):
+    if cfg.on_extraction not in ("print", "save_numpy", "save_pickle", "save_jpg"):
         raise ValueError(f"unknown on_extraction: {cfg.on_extraction}")
+    if cfg.on_extraction == "save_jpg" and cfg.feature_type not in ("raft", "pwc"):
+        raise ValueError(
+            "save_jpg writes quantized flow JPEGs and only applies to "
+            f"flow features (raft/pwc), not {cfg.feature_type!r}"
+        )
     if cfg.show_pred:
         # predictions interleave across workers; pin to one device
         cfg = cfg.replace(device_ids=[cfg.device_ids[0]] if cfg.device_ids else [0])
@@ -167,7 +184,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--tmp_path", default="./tmp")
     p.add_argument("--keep_tmp_files", action="store_true", default=False)
     p.add_argument("--on_extraction", default="print",
-                   choices=["print", "save_numpy", "save_pickle"])
+                   choices=["print", "save_numpy", "save_pickle", "save_jpg"])
     p.add_argument("--output_path", default="./output")
     p.add_argument("--output_direct", action="store_true",
                    help="save as <stem>.npy instead of <stem>_<key>.npy")
@@ -185,6 +202,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     # TPU-native extras
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--weights_path", type=str, default=None)
+    p.add_argument("--allow_random_init", action="store_true", default=False,
+                   help="run with random weights when --weights_path is "
+                        "absent/incomplete (features will be meaningless; "
+                        "for tests/benchmarks)")
     p.add_argument("--decode_workers", type=int, default=2)
     p.add_argument("--host_preprocess", default="pil", choices=["pil", "native"])
     p.add_argument("--resume", action="store_true", default=False,

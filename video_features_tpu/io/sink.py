@@ -98,17 +98,33 @@ def action_on_extraction(
                     pickle.dump(value, f)
             os.replace(tmp, fpath)
         elif on_extraction == "save_jpg":
-            # flow (T, 2, H, W) -> per-pair x/y grayscale jpgs
+            # flow (T, 2, H, W) float -> per-pair flow_x_/flow_y_ grayscale
+            # jpgs holding the uint8-quantized flow (clamp ±20, 128+255/40·f
+            # — the I3D flow quantization, ref transforms.py:33-51).
+            # Divergences from the reference's vestigial branch (ref
+            # utils/utils.py:98-110): its `for f_num in value.shape[0]`
+            # iterates an int (crash), it writes raw float arrays (junk
+            # pixels), and its `<n>_x.jpg` names don't match what its own
+            # flow reader globs for — files here are named
+            # flow_x_<n>.jpg/flow_y_<n>.jpg so `--flow_type flow
+            # --flow_dir` can consume them directly (round-trip closed).
+            if value.ndim != 4 or value.shape[1] != 2:
+                raise ValueError(
+                    f"save_jpg needs (T, 2, H, W) flow, got {value.shape} "
+                    f"for key {key!r} (use raft/pwc features)"
+                )
             from PIL import Image
 
-            os.makedirs(output_path, exist_ok=True)
+            from video_features_tpu.ops.preprocess import flow_quantize_uint8_np
+
+            quant = flow_quantize_uint8_np(value)
             vdir = os.path.join(output_path, name)
             os.makedirs(vdir, exist_ok=True)
-            for f_num in range(value.shape[0]):
+            for f_num in range(quant.shape[0]):
                 for ch, axis in enumerate("xy"):
-                    img = Image.fromarray(value[f_num, ch].astype(np.uint8))
-                    img.convert("L").save(
-                        os.path.join(vdir, f"{f_num:0>5d}_{axis}.jpg")
+                    Image.fromarray(quant[f_num, ch], mode="L").save(
+                        os.path.join(vdir, f"flow_{axis}_{f_num:0>5d}.jpg"),
+                        quality=95,
                     )
         else:
             raise NotImplementedError(f"on_extraction: {on_extraction} is not implemented")

@@ -58,21 +58,55 @@ def probe(path: str) -> VideoMeta:
 
 
 def read_frames_at_indices(path: str, indices) -> dict:
-    """Sequential decode returning {index: rgb_uint8_hwc} for the wanted
-    frame indices; indices past the decodable end are simply absent."""
-    need = set(int(i) for i in indices)
+    """Decode returning {index: rgb_uint8_hwc} for the wanted frame
+    indices; indices past the decodable end are simply absent.
+
+    When the wanted set is sparse relative to its span (e.g. I3D with a
+    low ``--extraction_fps`` over a long video), seeks via
+    ``CAP_PROP_POS_FRAMES`` instead of decoding every frame up to
+    ``max(indices)`` — the analog of the reference's ``mmcv
+    VideoReader.get_frame`` random access (ref extract_i3d.py:246-248).
+    Dense sets keep the sequential decode (seek + keyframe re-decode
+    would be slower, and sequential reads are always frame-exact)."""
+    need = sorted(set(int(i) for i in indices))
     if not need:
         return {}
+    span = need[-1] + 1
+
+    if len(need) * 8 < span:
+        # sparse: random-access each wanted frame. Same semantics (and the
+        # same codec-dependent accuracy caveats) as the reference's mmcv
+        # VideoReader.get_frame, which also seeks via CAP_PROP_POS_FRAMES.
+        # Guard: if the backend doesn't honor a seek (POS_FRAMES readback
+        # mismatch), fall through to the always-exact sequential decode
+        # rather than silently returning wrong frames.
+        got = {}
+        cap = cv2.VideoCapture(str(path))
+        try:
+            seek_ok = True
+            for i in need:
+                cap.set(cv2.CAP_PROP_POS_FRAMES, i)
+                if int(cap.get(cv2.CAP_PROP_POS_FRAMES)) != i:
+                    seek_ok = False
+                    break
+                ok, frame = cap.read()
+                if ok:
+                    got[i] = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+        finally:
+            cap.release()
+        if seek_ok:
+            return got
+
     got = {}
+    wanted = set(need)
     cap = cv2.VideoCapture(str(path))
     try:
-        last = max(need)
         i = 0
-        while i <= last:
+        while i < span:
             ok, frame = cap.read()
             if not ok:
                 break
-            if i in need:
+            if i in wanted:
                 got[i] = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
             i += 1
     finally:

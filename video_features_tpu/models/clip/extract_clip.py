@@ -25,7 +25,7 @@ from video_features_tpu.io.paths import video_path_of
 from video_features_tpu.io.video import extract_frames
 from video_features_tpu.models.clip.convert import convert_state_dict
 from video_features_tpu.models.clip.model import CONFIGS, VisionTransformer, init_params
-from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.ops.preprocess import (
     CLIP_MEAN,
     CLIP_STD,
@@ -56,12 +56,27 @@ class ExtractCLIP(BaseExtractor):
                     lambda sd: convert_state_dict(sd, self.model_cfg.layers),
                 )
             else:
+                random_init_fallback(
+                    self.config, self.feature_type,
+                    "an OpenAI CLIP / HF CLIP-vision state dict "
+                    "(.pt/.npz) or a converted flax .msgpack",
+                )
                 self._host_params = init_params(self.model_cfg)
         return self._host_params
 
     def _build(self, device):
-        model = VisionTransformer(self.model_cfg)
-        params = jax.device_put(self._load_host_params(), device)
+        from video_features_tpu.models.common.weights import (
+            cast_floats_for_compute,
+            compute_dtype,
+        )
+
+        dt = compute_dtype(self.config)
+        model = VisionTransformer(self.model_cfg, dtype=dt)
+        params = self._load_host_params()
+        if dt != jnp.float32:
+            # final projection stays fp32 (the 512-d embedding contract)
+            params = cast_floats_for_compute(params, dt, exclude=("proj",))
+        params = jax.device_put(params, device)
 
         @jax.jit
         def encode_image(p, x):
@@ -75,7 +90,9 @@ class ExtractCLIP(BaseExtractor):
         img = pil_center_crop(img, size)
         return normalize_chw(to_float_chw(img), CLIP_MEAN, CLIP_STD)
 
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+    # host half: decode + PIL preprocess + static-shape pad (runs on
+    # --decode_workers threads under the async pipeline)
+    def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
         frames, fps, timestamps_ms = extract_frames(
             video_path, self.config.extract_method
@@ -83,6 +100,11 @@ class ExtractCLIP(BaseExtractor):
         batch = np.stack([self._preprocess(f) for f in frames])  # (T, 3, H, W)
         T = batch.shape[0]
         padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
+        return padded, T, fps, timestamps_ms
+
+    # device half: transfer + jitted encode
+    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+        padded, T, fps, timestamps_ms = payload
         x = jax.device_put(jnp.asarray(padded), state["device"])
         feats = np.asarray(state["encode_image"](state["params"], x))[:T]
         return {

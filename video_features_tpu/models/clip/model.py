@@ -58,14 +58,15 @@ def quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
 class Attention(nn.Module):
     width: int
     heads: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (N, L, D)
         N, L, D = x.shape
         hd = self.width // self.heads
-        q = nn.Dense(self.width, name="q_proj")(x)
-        k = nn.Dense(self.width, name="k_proj")(x)
-        v = nn.Dense(self.width, name="v_proj")(x)
+        q = nn.Dense(self.width, dtype=self.dtype, name="q_proj")(x)
+        k = nn.Dense(self.width, dtype=self.dtype, name="k_proj")(x)
+        v = nn.Dense(self.width, dtype=self.dtype, name="v_proj")(x)
         q = q.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
@@ -73,7 +74,7 @@ class Attention(nn.Module):
         attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(x.dtype)
         out = jnp.einsum("nhqk,nhkd->nhqd", attn, v, precision=HIGHEST)
         out = out.transpose(0, 2, 1, 3).reshape(N, L, D)
-        return nn.Dense(self.width, name="out_proj")(out)
+        return nn.Dense(self.width, dtype=self.dtype, name="out_proj")(out)
 
 
 class Block(nn.Module):
@@ -81,22 +82,33 @@ class Block(nn.Module):
     heads: int
     quick_gelu: bool
     eps: float
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # LayerNorm statistics stay fp32 under --dtype bfloat16; the
+        # residual stream and the MXU matmuls run in self.dtype
         act = quick_gelu if self.quick_gelu else nn.gelu
-        y = nn.LayerNorm(epsilon=self.eps, name="ln_1")(x)
-        x = x + Attention(self.width, self.heads, name="attn")(y)
-        y = nn.LayerNorm(epsilon=self.eps, name="ln_2")(x)
-        y = nn.Dense(self.width * 4, name="c_fc")(y)
-        y = nn.Dense(self.width, name="c_proj")(act(y))
+        y = nn.LayerNorm(epsilon=self.eps, dtype=jnp.float32, name="ln_1")(x)
+        y = y.astype(self.dtype)
+        x = x + Attention(self.width, self.heads, self.dtype, name="attn")(y)
+        y = nn.LayerNorm(epsilon=self.eps, dtype=jnp.float32, name="ln_2")(x)
+        y = y.astype(self.dtype)
+        y = nn.Dense(self.width * 4, dtype=self.dtype, name="c_fc")(y)
+        y = nn.Dense(self.width, dtype=self.dtype, name="c_proj")(act(y))
         return x + y
 
 
 class VisionTransformer(nn.Module):
-    """``encode_image``: (N, 3, H, W) normalized fp32 -> (N, embed_dim)."""
+    """``encode_image``: (N, 3, H, W) normalized fp32 -> (N, embed_dim).
+
+    ``dtype=jnp.bfloat16`` runs the residual stream and every MXU matmul
+    in bf16 (params should be cast with ``cast_floats_for_compute``);
+    LayerNorm statistics, attention softmax, and the final projection
+    stay fp32. Output is always fp32."""
 
     cfg: CLIPVisionConfig
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -109,6 +121,7 @@ class VisionTransformer(nn.Module):
             strides=(c.patch_size, c.patch_size),
             use_bias=False,
             padding="VALID",
+            dtype=self.dtype,
             name="conv1",
         )(x)
         x = x.reshape(N, -1, c.width)  # (N, grid*grid, width)
@@ -121,16 +134,21 @@ class VisionTransformer(nn.Module):
             nn.initializers.normal(c.width ** -0.5),
             (c.grid * c.grid + 1, c.width),
         )
-        x = jnp.concatenate([jnp.tile(cls[None, None], (N, 1, 1)), x], axis=1)
-        x = x + pos[None]
-        x = nn.LayerNorm(epsilon=c.eps, name="ln_pre")(x)
+        x = jnp.concatenate([jnp.tile(cls[None, None], (N, 1, 1)).astype(x.dtype), x], axis=1)
+        x = (x + pos[None]).astype(self.dtype)
+        x = nn.LayerNorm(epsilon=c.eps, dtype=jnp.float32, name="ln_pre")(x)
+        x = x.astype(self.dtype)
         for i in range(c.layers):
-            x = Block(c.width, c.heads, c.quick_gelu, c.eps, name=f"resblock_{i}")(x)
-        x = nn.LayerNorm(epsilon=c.eps, name="ln_post")(x[:, 0])
+            x = Block(c.width, c.heads, c.quick_gelu, c.eps, self.dtype,
+                      name=f"resblock_{i}")(x)
+        x = nn.LayerNorm(epsilon=c.eps, dtype=jnp.float32, name="ln_post")(x[:, 0])
         proj = self.param(
             "proj", nn.initializers.normal(c.width ** -0.5), (c.width, c.embed_dim)
         )
-        return jnp.dot(x, proj, precision=HIGHEST)
+        # fp32 projection regardless of dtype: the 512-d embedding is the
+        # user-facing contract
+        return jnp.dot(x.astype(jnp.float32), proj.astype(jnp.float32),
+                       precision=HIGHEST)
 
 
 def init_params(cfg: CLIPVisionConfig, seed: int = 0):

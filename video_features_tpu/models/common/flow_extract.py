@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.paths import video_path_of
 from video_features_tpu.io.video import probe, stream_frames
-from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.ops.preprocess import pil_resize
 
 
@@ -73,6 +73,12 @@ class PairwiseFlowExtractor(BaseExtractor):
                     self.config.weights_path, type(self)._convert_state_dict
                 )
             else:
+                random_init_fallback(
+                    self.config, self.feature_type,
+                    "the reference flow checkpoint (raft: raft-sintel.pth; "
+                    "pwc: network-default.pytorch) or a converted flax "
+                    ".msgpack",
+                )
                 self._host_params = self._init_params()
         return self._host_params
 

@@ -24,4 +24,7 @@ class EvalBatchNorm(nn.Module):
         mean = self.param("mean", nn.initializers.zeros, (C,))
         var = self.param("var", nn.initializers.ones, (C,))
         inv = scale * jax.lax.rsqrt(var + self.eps)
-        return x * inv + (bias - mean * inv)
+        # stats/fold math stays fp32 under --dtype bfloat16 (stats are
+        # fp32 params; promotion does the rest); activations keep their
+        # incoming dtype so the bf16 stream isn't silently widened
+        return (x.astype(jnp.float32) * inv + (bias - mean * inv)).astype(x.dtype)

@@ -120,6 +120,56 @@ def check_all_consumed(sd: Dict[str, np.ndarray], consumed, model_name: str) -> 
         )
 
 
+def cast_floats_for_compute(params: Any, dtype, exclude=()):
+    """Cast float kernels (ndim >= 2) to the compute dtype for
+    ``--dtype bfloat16``; 1-d leaves (biases, norm scales/stats) stay fp32
+    — their math is pinned fp32 in the models. ``exclude`` lists param
+    path-name substrings kept fp32 (e.g. CLIP's final 'proj')."""
+    import jax
+    import jax.numpy as jnp
+
+    def cast(path, x):
+        names = [str(getattr(p, "key", "")) for p in path]
+        if any(e in names for e in exclude):
+            return x
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) and x.ndim >= 2:
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def compute_dtype(config):
+    """The jnp dtype for --dtype (config.py)."""
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if getattr(config, "dtype", "float32") == "bfloat16" else jnp.float32
+
+
+def random_init_fallback(config, model_name: str, expected: str) -> None:
+    """Gate the no-weights path: loud by default.
+
+    The reference never silently runs a random-weight model — it either
+    auto-downloads (CLIP via pip, vggish via URL) or crashes on a missing
+    checkpoint path (ref models/i3d/extract_i3d.py:23-26). Callers invoke
+    this before falling back to deterministic random init; it raises
+    unless ``--allow_random_init`` was passed, and warns loudly when it
+    was.
+    """
+    if getattr(config, "allow_random_init", False):
+        print(
+            f"WARNING: {model_name}: no pretrained weights loaded — running "
+            "with deterministic random init; extracted features are "
+            "MEANINGLESS (--allow_random_init)."
+        )
+        return
+    raise RuntimeError(
+        f"{model_name}: no pretrained weights. Expected {expected}. "
+        "Pass --weights_path, or --allow_random_init to run with random "
+        "weights (meaningless features; tests/benchmarks only)."
+    )
+
+
 def tree_to_device(params: Any, device):
     import jax
 

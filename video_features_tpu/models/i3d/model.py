@@ -49,6 +49,7 @@ class Unit3D(nn.Module):
     use_bn: bool = True
     use_bias: bool = False
     activation: bool = True
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -58,6 +59,7 @@ class Unit3D(nn.Module):
             strides=self.stride,
             padding=tf_same_pads(self.kernel, self.stride),
             use_bias=self.use_bias,
+            dtype=self.dtype,
             name="conv3d",
         )(x)
         if self.use_bn:
@@ -86,20 +88,16 @@ class Mixed(nn.Module):
     (ref i3d_net.py:123-157)."""
 
     out: Sequence[int]
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         o = self.out
-        b0 = Unit3D(o[0], name="branch_0")(x)
-        b1 = Unit3D(o[2], (3, 3, 3), name="branch_1_1")(
-            Unit3D(o[1], name="branch_1_0")(x)
-        )
-        b2 = Unit3D(o[4], (3, 3, 3), name="branch_2_1")(
-            Unit3D(o[3], name="branch_2_0")(x)
-        )
-        b3 = Unit3D(o[5], name="branch_3_1")(
-            max_pool_tf(x, (3, 3, 3), (1, 1, 1))
-        )
+        u = lambda *a, **kw: Unit3D(*a, dtype=self.dtype, **kw)
+        b0 = u(o[0], name="branch_0")(x)
+        b1 = u(o[2], (3, 3, 3), name="branch_1_1")(u(o[1], name="branch_1_0")(x))
+        b2 = u(o[4], (3, 3, 3), name="branch_2_1")(u(o[3], name="branch_2_0")(x))
+        b3 = u(o[5], name="branch_3_1")(max_pool_tf(x, (3, 3, 3), (1, 1, 1)))
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -108,28 +106,31 @@ class I3D(nn.Module):
     (features (B, 1024), logits (B, num_classes))."""
 
     num_classes: int = I3D_NUM_CLASSES
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        x = Unit3D(64, (7, 7, 7), (2, 2, 2), name="conv3d_1a_7x7")(x)
+        x = x.astype(self.dtype)
+        x = Unit3D(64, (7, 7, 7), (2, 2, 2), dtype=self.dtype, name="conv3d_1a_7x7")(x)
         x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
-        x = Unit3D(64, name="conv3d_2b_1x1")(x)
-        x = Unit3D(192, (3, 3, 3), name="conv3d_2c_3x3")(x)
+        x = Unit3D(64, dtype=self.dtype, name="conv3d_2b_1x1")(x)
+        x = Unit3D(192, (3, 3, 3), dtype=self.dtype, name="conv3d_2c_3x3")(x)
         x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
-        x = Mixed([64, 96, 128, 16, 32, 32], name="mixed_3b")(x)
-        x = Mixed([128, 128, 192, 32, 96, 64], name="mixed_3c")(x)
+        x = Mixed([64, 96, 128, 16, 32, 32], self.dtype, name="mixed_3b")(x)
+        x = Mixed([128, 128, 192, 32, 96, 64], self.dtype, name="mixed_3c")(x)
         x = max_pool_tf(x, (3, 3, 3), (2, 2, 2))
-        x = Mixed([192, 96, 208, 16, 48, 64], name="mixed_4b")(x)
-        x = Mixed([160, 112, 224, 24, 64, 64], name="mixed_4c")(x)
-        x = Mixed([128, 128, 256, 24, 64, 64], name="mixed_4d")(x)
-        x = Mixed([112, 144, 288, 32, 64, 64], name="mixed_4e")(x)
-        x = Mixed([256, 160, 320, 32, 128, 128], name="mixed_4f")(x)
+        x = Mixed([192, 96, 208, 16, 48, 64], self.dtype, name="mixed_4b")(x)
+        x = Mixed([160, 112, 224, 24, 64, 64], self.dtype, name="mixed_4c")(x)
+        x = Mixed([128, 128, 256, 24, 64, 64], self.dtype, name="mixed_4d")(x)
+        x = Mixed([112, 144, 288, 32, 64, 64], self.dtype, name="mixed_4e")(x)
+        x = Mixed([256, 160, 320, 32, 128, 128], self.dtype, name="mixed_4f")(x)
         x = max_pool_tf(x, (2, 2, 2), (2, 2, 2))
-        x = Mixed([256, 160, 320, 32, 128, 128], name="mixed_5b")(x)
-        x = Mixed([384, 192, 384, 48, 128, 128], name="mixed_5c")(x)
+        x = Mixed([256, 160, 320, 32, 128, 128], self.dtype, name="mixed_5b")(x)
+        x = Mixed([384, 192, 384, 48, 128, 128], self.dtype, name="mixed_5c")(x)
 
-        # AvgPool3d((2, 7, 7), stride 1), VALID (ref i3d_net.py:227)
-        x = nn.avg_pool(x, (2, 7, 7), strides=(1, 1, 1))  # (B, T', 1, 1, 1024)
+        # AvgPool3d((2, 7, 7), stride 1), VALID (ref i3d_net.py:227);
+        # fp32 pooling + heads: features are the user-facing contract
+        x = nn.avg_pool(x.astype(jnp.float32), (2, 7, 7), strides=(1, 1, 1))
         feats = jnp.mean(x, axis=(1, 2, 3))  # time-avg -> (B, 1024)
 
         logits = Unit3D(
@@ -143,8 +144,8 @@ class I3D(nn.Module):
         return feats, logits
 
 
-def build(num_classes: int = I3D_NUM_CLASSES) -> I3D:
-    return I3D(num_classes=num_classes)
+def build(num_classes: int = I3D_NUM_CLASSES, dtype=jnp.float32) -> I3D:
+    return I3D(num_classes=num_classes, dtype=dtype)
 
 
 def init_params(modality: str, seed: int = 0, num_classes: int = I3D_NUM_CLASSES):

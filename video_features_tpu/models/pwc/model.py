@@ -128,6 +128,10 @@ class Decoder(nn.Module):
             volume = _lrelu(local_correlation_nhwc(feat1, warped))
             feat = jnp.concatenate([volume, feat1, flow_up, feat_up], axis=-1)
 
+        assert feat.shape[-1] == DECODER_IN[self.level], (
+            f"decoder level {self.level}: input width {feat.shape[-1]} != "
+            f"{DECODER_IN[self.level]}"
+        )
         for i, ch in enumerate((128, 128, 96, 64, 32)):
             feat = jnp.concatenate([_lrelu(_conv(ch, name=f"conv{i}")(feat)), feat], -1)
         flow = _conv(2, name="flow")(feat)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.paths import form_slices, video_path_of
 from video_features_tpu.io.video import read_all_frames
-from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.models.r21d.convert import convert_state_dict
 from video_features_tpu.models.r21d.model import R21D_FEATURE_DIM, build, init_params
 from video_features_tpu.ops.preprocess import KINETICS_MEAN, KINETICS_STD
@@ -81,12 +81,26 @@ class ExtractR21D(BaseExtractor):
                     self.config.weights_path, convert_state_dict
                 )
             else:
+                random_init_fallback(
+                    self.config, self.feature_type,
+                    "a torchvision r2plus1d_18 (Kinetics-400) state dict "
+                    "(.pt/.pth) or a converted flax .msgpack",
+                )
                 self._host_params = init_params()
         return self._host_params
 
     def _build(self, device):
-        model = build()
-        params = jax.device_put(self._load_host_params(), device)
+        from video_features_tpu.models.common.weights import (
+            cast_floats_for_compute,
+            compute_dtype,
+        )
+
+        dt = compute_dtype(self.config)
+        model = build(dtype=dt)
+        params = self._load_host_params()
+        if dt != jnp.float32:
+            params = cast_floats_for_compute(params, dt, exclude=("fc",))
+        params = jax.device_put(params, device)
 
         @jax.jit
         def forward(p, stacks_uint8):  # (B, stack, H, W, 3) uint8
@@ -94,24 +108,34 @@ class ExtractR21D(BaseExtractor):
 
         return {"params": params, "forward": forward, "device": device}
 
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+    # host half: whole-clip decode + uint8 window batching (runs on
+    # --decode_workers threads under the async pipeline; frames cross to
+    # the device half as uint8, so prefetching holds 4x less memory than
+    # it would after float conversion)
+    def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
         frames, _, _ = read_all_frames(video_path, self.config.extraction_fps)
         if not frames:
             raise IOError(f"no frames decoded from {video_path}")
         clip = np.stack(frames)  # (T, H, W, 3) uint8, stays on host
         slices = form_slices(clip.shape[0], self.stack_size, self.step_size)
+        batches = []
+        for i in range(0, len(slices), self.batch_size):
+            chunk = slices[i : i + self.batch_size]
+            stacks = np.stack([clip[s:e] for s, e in chunk])
+            batches.append((pad_batch(stacks, self.batch_size), stacks.shape[0]))
+        return batches, slices
+
+    # device half: transfer + fused preprocess/forward per window batch
+    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+        video_path = video_path_of(path_entry)
+        batches, slices = payload
         if not slices:
             return {self.feature_type: np.zeros((0, R21D_FEATURE_DIM), np.float32)}
 
         feats_out, logits_out = [], []
-        for i in range(0, len(slices), self.batch_size):
-            chunk = slices[i : i + self.batch_size]
-            stacks = np.stack([clip[s:e] for s, e in chunk])
-            n = stacks.shape[0]
-            x = jax.device_put(
-                jnp.asarray(pad_batch(stacks, self.batch_size)), state["device"]
-            )
+        for padded, n in batches:
+            x = jax.device_put(jnp.asarray(padded), state["device"])
             feats, logits = state["forward"](state["params"], x)
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:

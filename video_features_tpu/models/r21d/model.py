@@ -40,6 +40,7 @@ class Conv2Plus1D(nn.Module):
     mid: int
     features: int
     stride: int = 1
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -49,6 +50,7 @@ class Conv2Plus1D(nn.Module):
             strides=(1, self.stride, self.stride),
             padding=[(0, 0), (1, 1), (1, 1)],
             use_bias=False,
+            dtype=self.dtype,
             name="spatial",
         )(x)
         x = nn.relu(EvalBatchNorm(name="bn_mid")(x))
@@ -58,6 +60,7 @@ class Conv2Plus1D(nn.Module):
             strides=(self.stride, 1, 1),
             padding=[(1, 1), (0, 0), (0, 0)],
             use_bias=False,
+            dtype=self.dtype,
             name="temporal",
         )(x)
         return x
@@ -67,6 +70,7 @@ class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     downsample: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -75,9 +79,9 @@ class BasicBlock(nn.Module):
         # and reuses it for BOTH factorized convs of the block
         mid = midplanes(in_ch, self.planes)
         identity = x
-        out = Conv2Plus1D(mid, self.planes, self.stride, name="conv1")(x)
+        out = Conv2Plus1D(mid, self.planes, self.stride, self.dtype, name="conv1")(x)
         out = nn.relu(EvalBatchNorm(name="bn1")(out))
-        out = Conv2Plus1D(mid, self.planes, 1, name="conv2")(out)
+        out = Conv2Plus1D(mid, self.planes, 1, self.dtype, name="conv2")(out)
         out = EvalBatchNorm(name="bn2")(out)
         if self.downsample:
             identity = nn.Conv(
@@ -85,6 +89,7 @@ class BasicBlock(nn.Module):
                 (1, 1, 1),
                 strides=(self.stride,) * 3,
                 use_bias=False,
+                dtype=self.dtype,
                 name="downsample_conv",
             )(x)
             identity = EvalBatchNorm(name="downsample_bn")(identity)
@@ -96,6 +101,7 @@ class R2Plus1D(nn.Module):
 
     layers: Sequence[int] = (2, 2, 2, 2)
     num_classes: int = 400
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -105,6 +111,7 @@ class R2Plus1D(nn.Module):
             strides=(1, 2, 2),
             padding=[(0, 0), (3, 3), (3, 3)],
             use_bias=False,
+            dtype=self.dtype,
             name="stem_conv1",
         )(x)
         x = nn.relu(EvalBatchNorm(name="stem_bn1")(x))
@@ -114,6 +121,7 @@ class R2Plus1D(nn.Module):
             strides=(1, 1, 1),
             padding=[(1, 1), (0, 0), (0, 0)],
             use_bias=False,
+            dtype=self.dtype,
             name="stem_conv2",
         )(x)
         x = nn.relu(EvalBatchNorm(name="stem_bn2")(x))
@@ -125,16 +133,17 @@ class R2Plus1D(nn.Module):
             for b in range(n_blocks):
                 s = stride if b == 0 else 1
                 need_ds = s != 1 or in_planes != planes
-                x = BasicBlock(planes, s, need_ds, name=f"layer{stage + 1}_{b}")(x)
+                x = BasicBlock(planes, s, need_ds, self.dtype, name=f"layer{stage + 1}_{b}")(x)
                 in_planes = planes
 
-        feats = jnp.mean(x, axis=(1, 2, 3))  # global spatio-temporal average pool
+        # fp32 pool + head: features are the user-facing contract
+        feats = jnp.mean(x.astype(jnp.float32), axis=(1, 2, 3))
         logits = nn.Dense(self.num_classes, name="fc")(feats)
         return feats, logits
 
 
-def build(num_classes: int = 400) -> R2Plus1D:
-    return R2Plus1D(num_classes=num_classes)
+def build(num_classes: int = 400, dtype=jnp.float32) -> R2Plus1D:
+    return R2Plus1D(num_classes=num_classes, dtype=dtype)
 
 
 def init_params(seed: int = 0, num_classes: int = 400):

@@ -48,6 +48,14 @@ def _encoder(sd: Dict[str, np.ndarray], enc: str, batch_norm: bool, consumed):
                 blk["downsample"] = _conv(sd, f"{ref}.downsample.0", consumed)
                 if batch_norm:
                     blk["norm3"] = bn_params(sd, f"{ref}.downsample.1", consumed)
+                    # the downsample norm is registered twice in the source
+                    # module — as `downsample.1` AND as `norm3` (ref
+                    # raft_src/extractor.py:26,44-45) — so a state_dict
+                    # taken from the live model carries alias keys
+                    for suffix in ("weight", "bias", "running_mean", "running_var"):
+                        alias = f"{ref}.norm3.{suffix}"
+                        if alias in sd:
+                            consumed.add(alias)
             params[f"layer{layer}_{b}"] = blk
     return params
 

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.paths import video_path_of
 from video_features_tpu.io.video import stream_frames
-from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.models.resnet.convert import convert_state_dict
 from video_features_tpu.models.resnet.model import build, init_params
 from video_features_tpu.ops.preprocess import imagenet_preprocess
@@ -48,12 +48,26 @@ class ExtractResNet(BaseExtractor):
                     lambda sd: convert_state_dict(sd, self.feature_type),
                 )
             else:
+                random_init_fallback(
+                    self.config, self.feature_type,
+                    f"a torchvision {self.feature_type} state dict "
+                    "(.pt/.pth) or a converted flax .msgpack",
+                )
                 self._host_params = init_params(self.feature_type)
         return self._host_params
 
     def _build(self, device):
-        model = build(self.feature_type)
-        params = jax.device_put(self._load_host_params(), device)
+        from video_features_tpu.models.common.weights import (
+            cast_floats_for_compute,
+            compute_dtype,
+        )
+
+        dt = compute_dtype(self.config)
+        model = build(self.feature_type, dtype=dt)
+        params = self._load_host_params()
+        if dt != jnp.float32:
+            params = cast_floats_for_compute(params, dt, exclude=("fc",))
+        params = jax.device_put(params, device)
 
         @jax.jit
         def forward(p, x):
@@ -61,32 +75,36 @@ class ExtractResNet(BaseExtractor):
 
         return {"params": params, "forward": forward, "device": device}
 
+    def _decide_native(self) -> None:
+        if self.config.host_preprocess == "native":
+            from video_features_tpu import native
+
+            self._use_native = native.available()
+            if not self._use_native:
+                print(
+                    f"native preprocess unavailable "
+                    f"({native.build_error()}); using PIL"
+                )
+            else:
+                # share host cores across concurrent device workers
+                from video_features_tpu.parallel.devices import resolve_devices
+
+                n_workers = max(len(resolve_devices(self.config)), 1)
+                self._native_threads = max((os.cpu_count() or 1) // n_workers, 1)
+        else:
+            self._use_native = False
+
     def _preprocess_batch(self, batch: List[np.ndarray]) -> np.ndarray:
         """raw uint8 HWC frames -> (n, 3, 224, 224) normalized float32.
 
         'native' routes through the threaded C++ chain (same-resolution
         frames batched in one call); 'pil' is the reference-exact path.
-        The backend decision (and any unavailability warning) happens once."""
-        if self._use_native is None:
-            if self.config.host_preprocess == "native":
-                from video_features_tpu import native
-
-                self._use_native = native.available()
-                if not self._use_native:
-                    print(
-                        f"native preprocess unavailable "
-                        f"({native.build_error()}); using PIL"
-                    )
-                else:
-                    # share host cores across concurrent device workers
-                    from video_features_tpu.parallel.devices import resolve_devices
-
-                    n_workers = max(len(resolve_devices(self.config)), 1)
-                    self._native_threads = max(
-                        (os.cpu_count() or 1) // n_workers, 1
-                    )
-            else:
-                self._use_native = False
+        The backend decision (and any unavailability warning) happens once;
+        the lock keeps it single-shot now that decode worker threads call
+        this concurrently."""
+        with self._build_lock:
+            if self._use_native is None:
+                self._decide_native()
         if self._use_native:
             from video_features_tpu import native
 
@@ -95,40 +113,101 @@ class ExtractResNet(BaseExtractor):
             )
         return np.stack([imagenet_preprocess(f) for f in batch])
 
-    def _run_batch(self, state, batch: List[np.ndarray], feats_out: List[np.ndarray]):
-        """Pad to the static batch size, run, keep the valid rows
-        (ref extract_resnet.py:104-116)."""
-        n = len(batch)
-        x = self._preprocess_batch(batch)
-        if n < self.batch_size:
-            x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
-        x = jax.device_put(jnp.asarray(x), state["device"])
-        feats, logits = state["forward"](state["params"], x)
-        feats_out.append(np.asarray(feats)[:n])
-        if self.config.show_pred:
-            show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
+    # A prepared video holds preprocessed fp32 224x224 frames (~600 KB
+    # each). Beyond this many frames (~2.5 GB) prepare() stops buffering
+    # and hands the decode back to the device thread as a stream — a
+    # pathological-length video must not OOM the host just because the
+    # pipeline wants to prefetch it (x decode_workers in-flight videos).
+    PIPELINE_MAX_FRAMES = 4096
 
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+    # host half: stream-decode + preprocess into padded static-shape
+    # batches (runs on --decode_workers threads under the async pipeline)
+    def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
+        fps = self.config.extraction_fps
+        batch: List[np.ndarray] = []
+        batches: List[np.ndarray] = []
+        counts: List[int] = []
+        timestamps_ms: List[float] = []
+
+        def flush():
+            n = len(batch)
+            x = self._preprocess_batch(batch)
+            if n < self.batch_size:
+                x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
+            batches.append(x)
+            counts.append(n)
+
+        n_frames = 0
+        for frame, ts in stream_frames(video_path, fps):
+            n_frames += 1
+            if n_frames > self.PIPELINE_MAX_FRAMES:
+                return ("stream", video_path)  # too big to prefetch whole
+            batch.append(frame)
+            timestamps_ms.append(ts)
+            if len(batch) == self.batch_size:
+                flush()
+                batch = []
+        if batch:
+            flush()
+        if not batches:
+            raise IOError(f"no frames decoded from {video_path}")
+        from video_features_tpu.io.video import probe
+
+        actual_fps = fps or probe(video_path).fps or 25.0
+        return batches, counts, actual_fps, timestamps_ms
+
+    def _extract_streaming(self, state, video_path) -> Dict[str, np.ndarray]:
+        """Bounded-memory fallback: decode/preprocess one batch at a time
+        on the consuming thread (the round-1 behavior; no video-level
+        prefetch, but host memory stays at one batch)."""
         fps = self.config.extraction_fps
         batch: List[np.ndarray] = []
         feats_out: List[np.ndarray] = []
         timestamps_ms: List[float] = []
-        actual_fps = None
+
+        def run(batch):
+            n = len(batch)
+            x = self._preprocess_batch(batch)
+            if n < self.batch_size:
+                x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
+            x = jax.device_put(jnp.asarray(x), state["device"])
+            feats, logits = state["forward"](state["params"], x)
+            feats_out.append(np.asarray(feats)[:n])
+            if self.config.show_pred:
+                show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
+
         for frame, ts in stream_frames(video_path, fps):
-            batch.append(frame)  # raw uint8; preprocessing happens per batch
+            batch.append(frame)
             timestamps_ms.append(ts)
             if len(batch) == self.batch_size:
-                self._run_batch(state, batch, feats_out)
+                run(batch)
                 batch = []
         if batch:
-            self._run_batch(state, batch, feats_out)
+            run(batch)
         if not feats_out:
             raise IOError(f"no frames decoded from {video_path}")
-        if actual_fps is None:
-            from video_features_tpu.io.video import probe
+        from video_features_tpu.io.video import probe
 
-            actual_fps = fps or probe(video_path).fps or 25.0
+        actual_fps = fps or probe(video_path).fps or 25.0
+        return {
+            self.feature_type: np.concatenate(feats_out, axis=0),
+            "fps": np.array(actual_fps),
+            "timestamps_ms": np.array(timestamps_ms),
+        }
+
+    # device half: transfer + jitted forward per batch
+    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+        if payload[0] == "stream":
+            return self._extract_streaming(state, payload[1])
+        batches, counts, actual_fps, timestamps_ms = payload
+        feats_out: List[np.ndarray] = []
+        for x, n in zip(batches, counts):
+            x = jax.device_put(jnp.asarray(x), state["device"])
+            feats, logits = state["forward"](state["params"], x)
+            feats_out.append(np.asarray(feats)[:n])
+            if self.config.show_pred:
+                show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
         return {
             self.feature_type: np.concatenate(feats_out, axis=0),
             "fps": np.array(actual_fps),

@@ -24,7 +24,8 @@ from flax import linen as nn
 from video_features_tpu.models.common.layers import EvalBatchNorm
 
 
-def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
+def _conv(features: int, kernel: int, stride: int = 1, name: str = None,
+          dtype=jnp.float32):
     pad = (kernel - 1) // 2
     return nn.Conv(
         features,
@@ -32,6 +33,7 @@ def _conv(features: int, kernel: int, stride: int = 1, name: str = None):
         strides=(stride, stride),
         padding=[(pad, pad), (pad, pad)],
         use_bias=False,
+        dtype=dtype,
         name=name,
     )
 
@@ -40,18 +42,20 @@ class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     downsample: bool = False
+    dtype: jnp.dtype = jnp.float32
     expansion = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         identity = x
-        out = _conv(self.planes, 3, self.stride, name="conv1")(x)
+        out = _conv(self.planes, 3, self.stride, name="conv1", dtype=self.dtype)(x)
         out = EvalBatchNorm(name="bn1")(out)
         out = nn.relu(out)
-        out = _conv(self.planes, 3, 1, name="conv2")(out)
+        out = _conv(self.planes, 3, 1, name="conv2", dtype=self.dtype)(out)
         out = EvalBatchNorm(name="bn2")(out)
         if self.downsample:
-            identity = _conv(self.planes, 1, self.stride, name="downsample_conv")(x)
+            identity = _conv(self.planes, 1, self.stride, name="downsample_conv",
+                             dtype=self.dtype)(x)
             identity = EvalBatchNorm(name="downsample_bn")(identity)
         return nn.relu(out + identity)
 
@@ -60,19 +64,21 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     downsample: bool = False
+    dtype: jnp.dtype = jnp.float32
     expansion = 4
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         identity = x
-        out = _conv(self.planes, 1, 1, name="conv1")(x)
+        out = _conv(self.planes, 1, 1, name="conv1", dtype=self.dtype)(x)
         out = nn.relu(EvalBatchNorm(name="bn1")(out))
-        out = _conv(self.planes, 3, self.stride, name="conv2")(out)
+        out = _conv(self.planes, 3, self.stride, name="conv2", dtype=self.dtype)(out)
         out = nn.relu(EvalBatchNorm(name="bn2")(out))
-        out = _conv(self.planes * 4, 1, 1, name="conv3")(out)
+        out = _conv(self.planes * 4, 1, 1, name="conv3", dtype=self.dtype)(out)
         out = EvalBatchNorm(name="bn3")(out)
         if self.downsample:
-            identity = _conv(self.planes * 4, 1, self.stride, name="downsample_conv")(x)
+            identity = _conv(self.planes * 4, 1, self.stride, name="downsample_conv",
+                             dtype=self.dtype)(x)
             identity = EvalBatchNorm(name="downsample_bn")(identity)
         return nn.relu(out + identity)
 
@@ -98,13 +104,14 @@ class ResNet(nn.Module):
     block: Type[nn.Module]
     layers: Sequence[int]
     num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU-native layout)
         x = nn.Conv(
             64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-            use_bias=False, name="conv1",
+            use_bias=False, dtype=self.dtype, name="conv1",
         )(x)
         x = nn.relu(EvalBatchNorm(name="bn1")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
@@ -117,18 +124,19 @@ class ResNet(nn.Module):
                 s = stride if b == 0 else 1
                 need_ds = s != 1 or in_planes != planes * self.block.expansion
                 x = self.block(
-                    planes, s, need_ds, name=f"layer{stage + 1}_{b}"
+                    planes, s, need_ds, self.dtype, name=f"layer{stage + 1}_{b}"
                 )(x)
                 in_planes = planes * self.block.expansion
 
-        feats = jnp.mean(x, axis=(1, 2))  # global average pool
+        # fp32 pool + head: features are the user-facing contract
+        feats = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         logits = nn.Dense(self.num_classes, name="fc")(feats)
         return feats, logits
 
 
-def build(arch: str, num_classes: int = 1000) -> ResNet:
+def build(arch: str, num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
     block, layers = ARCHS[arch]
-    return ResNet(block=block, layers=layers, num_classes=num_classes)
+    return ResNet(block=block, layers=layers, num_classes=num_classes, dtype=dtype)
 
 
 def init_params(arch: str, seed: int = 0, num_classes: int = 1000):

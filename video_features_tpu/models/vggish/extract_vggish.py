@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.audio import load_audio_for_model
 from video_features_tpu.io.paths import video_path_of
-from video_features_tpu.models.common.weights import load_params
+from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.models.vggish.convert import convert_state_dict
 from video_features_tpu.models.vggish.mel import SAMPLE_RATE, waveform_to_examples
 from video_features_tpu.models.vggish.model import (
@@ -47,6 +47,11 @@ class ExtractVGGish(BaseExtractor):
                     self.config.weights_path, convert_state_dict
                 )
             else:
+                random_init_fallback(
+                    self.config, self.feature_type,
+                    "a torchvggish state dict (vggish-10086976.pth) or a "
+                    "converted flax .msgpack",
+                )
                 self._host_params = init_params()
         return self._host_params
 
@@ -60,7 +65,9 @@ class ExtractVGGish(BaseExtractor):
 
         return {"params": params, "forward": forward, "device": device}
 
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+    # host half: wav rip + NumPy log-mel frontend (runs on
+    # --decode_workers threads under the async pipeline)
+    def prepare(self, path_entry):
         path = video_path_of(path_entry)
         samples = load_audio_for_model(
             path, SAMPLE_RATE, self.tmp_path, self.config.keep_tmp_files
@@ -68,12 +75,19 @@ class ExtractVGGish(BaseExtractor):
         examples = waveform_to_examples(samples, SAMPLE_RATE)  # (N, 96, 64)
         n = examples.shape[0]
         if n == 0:
-            return {
-                self.feature_type: np.zeros((0, VGGISH_EMBEDDING_DIM), np.float32)
-            }
+            return None, 0
         x = pad_batch(
             examples[..., None], bucket_size(n, buckets=self.config.shape_buckets)
         )
+        return x, n
+
+    # device half: transfer + jitted VGG forward
+    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+        x, n = payload
+        if n == 0:
+            return {
+                self.feature_type: np.zeros((0, VGGISH_EMBEDDING_DIM), np.float32)
+            }
         x = jax.device_put(jnp.asarray(x), state["device"])
         feats = np.asarray(state["forward"](state["params"], x))[:n]
         return {self.feature_type: feats}

@@ -58,9 +58,12 @@ def postprocess(embeddings: jnp.ndarray, pca: Dict[str, jnp.ndarray]) -> jnp.nda
     centered = embeddings - pca["pca_means"].reshape(1, -1)
     applied = centered @ pca["pca_eigen_vectors"].T
     clipped = jnp.clip(applied, QUANTIZE_MIN_VAL, QUANTIZE_MAX_VAL)
-    return jnp.round(
+    quantized = jnp.round(
         (clipped - QUANTIZE_MIN_VAL) * (255.0 / (QUANTIZE_MAX_VAL - QUANTIZE_MIN_VAL))
     )
+    # uint8, matching the reference's .astype(np.uint8) output contract
+    # (ref vggish_src/vggish_postprocess.py:83-91)
+    return quantized.astype(jnp.uint8)
 
 
 def build() -> VGGishNet:

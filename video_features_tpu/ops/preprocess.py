@@ -116,6 +116,21 @@ def scale_to_1_1(x: jnp.ndarray) -> jnp.ndarray:
 
 def flow_to_uint8(flow: jnp.ndarray, bound: float = 20.0) -> jnp.ndarray:
     """Clamp flow to [-bound, bound] and quantize to the uint8 grid kept as
-    float — the Clamp -> ToUInt8 chain (ref transforms.py:33-51)."""
+    float — the Clamp -> ToUInt8 chain (ref transforms.py:33-51). NB the
+    reference's formula yields 256.0 (not 255) at exactly +bound and keeps
+    it as float; preserved here for parity. Anything that must actually
+    STORE uint8 goes through :func:`flow_quantize_uint8_np`."""
     clamped = jnp.clip(flow, -bound, bound)
     return jnp.round(128.0 + 255.0 / (2 * bound) * clamped)
+
+
+def flow_quantize_uint8_np(flow, bound: float = 20.0):
+    """NumPy storage variant of :func:`flow_to_uint8` for the save_jpg
+    sink: same map, then clipped to 0..255 BEFORE the uint8 cast — at
+    exactly +bound the reference formula hits 256.0, which a bare
+    ``astype(uint8)`` would wrap to 0 (max-positive flow read back as
+    max-negative)."""
+    import numpy as np
+
+    q = np.round(128.0 + 255.0 / (2 * bound) * np.clip(flow, -bound, bound))
+    return np.clip(q, 0.0, 255.0).astype(np.uint8)
