@@ -12,7 +12,10 @@ import sys
 from video_features_tpu.config import parse_args
 from video_features_tpu.extract.registry import build_extractor
 from video_features_tpu.parallel.devices import resolve_devices
-from video_features_tpu.parallel.scheduler import parallel_feature_extraction
+from video_features_tpu.parallel.scheduler import (
+    mesh_feature_extraction,
+    parallel_feature_extraction,
+)
 
 
 def main(argv=None) -> None:
@@ -24,7 +27,10 @@ def main(argv=None) -> None:
 
     extractor = build_extractor(cfg)
     devices = resolve_devices(cfg)
-    parallel_feature_extraction(extractor, devices)
+    if cfg.sharding == "mesh":
+        mesh_feature_extraction(extractor, devices)
+    else:
+        parallel_feature_extraction(extractor, devices)
 
 
 if __name__ == "__main__":

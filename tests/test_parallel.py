@@ -169,6 +169,88 @@ def test_all_workers_dead_raises():
         parallel_feature_extraction(ex, jax.devices()[:2])
 
 
+# --- product mesh path: --sharding mesh (VERDICT r1 #5) --------------------
+
+
+def _run_main(sample_video, out, extra):
+    import main as cli
+
+    cli.main(
+        [
+            "--feature_type", "CLIP-ViT-B/32",
+            "--video_paths", sample_video,
+            "--extract_method", "uni_12",
+            "--on_extraction", "save_numpy",
+            "--output_path", str(out),
+            "--tmp_path", str(out) + "_tmp",
+            "--allow_random_init",
+        ]
+        + extra
+    )
+    files = sorted((out / "CLIP-ViT-B/32").glob("*.npy")) or sorted(
+        out.rglob("*.npy")
+    )
+    assert len(files) == 1
+    return np.load(files[0])
+
+
+def test_mesh_cli_matches_queue_outputs(sample_video, tmp_path):
+    """`--sharding mesh` through the real CLI produces the same features as
+    queue mode on the 8-virtual-device mesh (ref main.py:49-55 is the
+    surface being upgraded). Pure-DP mesh (model=1) must be byte-identical:
+    every frame's math is untouched, only placement changes. TP (model=2)
+    reorders the hidden-dim reductions (psum of partials), so it gets a
+    tight tolerance instead."""
+    queue = _run_main(sample_video, tmp_path / "q", ["--sharding", "queue"])
+    mesh_dp = _run_main(
+        sample_video, tmp_path / "m1", ["--sharding", "mesh", "--mesh_model", "1"]
+    )
+    np.testing.assert_array_equal(mesh_dp, queue)
+    mesh_tp = _run_main(
+        sample_video, tmp_path / "m2", ["--sharding", "mesh", "--mesh_model", "2"]
+    )
+    np.testing.assert_allclose(mesh_tp, queue, atol=2e-4)
+
+
+def test_mesh_rejects_unsupported_feature_type(sample_video, tmp_path):
+    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+    from video_features_tpu.parallel.scheduler import mesh_feature_extraction
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="raft",
+        video_paths=[sample_video],
+        tmp_path=str(tmp_path / "t"),
+        output_path=str(tmp_path / "o"),
+    )
+    ex = ExtractRAFT(cfg)
+    ex.progress.disable = True
+    with pytest.raises(ValueError, match="sharding mesh"):
+        mesh_feature_extraction(ex, jax.devices())
+
+
+def test_mesh_r21d_dp_matches_single_device(sample_video, tmp_path):
+    """DP-mesh batching for a stack-wise (non-CLIP) model: window batches
+    shard over 'data', weights replicate; features byte-identical."""
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="r21d_rgb",
+        video_paths=[sample_video],
+        batch_size=4,
+        tmp_path=str(tmp_path / "t"),
+        output_path=str(tmp_path / "o"),
+    )
+    ex = ExtractR21D(cfg, external_call=True)
+    ex.progress.disable = True
+    single = ex([0], device=jax.devices()[0])
+    mesh = make_mesh(jax.devices(), model=1)
+    sharded = ex([0], device=mesh)
+    np.testing.assert_array_equal(single[0]["r21d_rgb"], sharded[0]["r21d_rgb"])
+    assert single[0]["r21d_rgb"].shape[1] == 512
+
+
 def test_decode_workers_pipeline_outputs_identical(sample_video, tmp_path):
     """The async host pipeline (--decode_workers) must be a pure
     scheduling change: features bit-identical to the serial path."""

@@ -113,6 +113,19 @@ class ExtractionConfig:
     profile_dir: Optional[str] = None
     # Resolution buckets for XLA static shapes (see ops/window.py).
     shape_buckets: Optional[List[int]] = None
+    # Execution strategy over the selected devices (parallel/):
+    #   'queue' — the reference-style video-level data parallelism, one
+    #             model replica + work-queue thread per chip (scheduler.py);
+    #   'mesh'  — ONE GSPMD-sharded executable over a (data, model)
+    #             jax.sharding.Mesh of every selected chip: the frame/stack
+    #             batch shards over 'data' (for video models that is the
+    #             time axis — the sequence-parallel story) and, for
+    #             mesh-capable transformer models, weights shard
+    #             Megatron-style over 'model' (sharding.py). XLA inserts
+    #             the ICI collectives.
+    sharding: str = "queue"
+    # 'model' (tensor-parallel) axis size of the mesh; 'data' gets the rest.
+    mesh_model: int = 1
 
     def __post_init__(self) -> None:
         if self.streams is not None and not isinstance(self.streams, (list, tuple)):
@@ -161,6 +174,10 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         )
     if cfg.feature_type not in FEATURE_TYPES:
         raise ValueError(f"unknown feature_type: {cfg.feature_type}")
+    if cfg.sharding not in ("queue", "mesh"):
+        raise ValueError(f"unknown sharding strategy: {cfg.sharding}")
+    if cfg.mesh_model < 1:
+        raise ValueError(f"mesh_model must be >= 1, got {cfg.mesh_model}")
     return cfg
 
 
@@ -212,6 +229,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="skip videos whose outputs already exist")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="write a jax.profiler trace + stage timing summary")
+    p.add_argument("--sharding", default="queue", choices=["queue", "mesh"],
+                   help="queue: one model replica + work queue per device; "
+                        "mesh: one GSPMD-sharded executable over a "
+                        "(data, model) mesh of all selected devices")
+    p.add_argument("--mesh_model", type=int, default=1,
+                   help="tensor-parallel axis size of the --sharding mesh")
     return p
 
 

@@ -34,6 +34,9 @@ class BaseExtractor:
     """Subclasses set ``feature_type`` and implement ``_build`` + ``extract``."""
 
     feature_type: str = ""
+    # True when _build accepts a jax.sharding.Mesh as ``device`` and runs
+    # one GSPMD-sharded executable over it (--sharding mesh).
+    mesh_capable: bool = False
 
     def __init__(self, config, external_call: bool = False) -> None:
         self.config = as_config(config)

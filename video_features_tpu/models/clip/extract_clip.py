@@ -38,6 +38,10 @@ from video_features_tpu.ops.window import bucket_size, pad_batch
 
 
 class ExtractCLIP(BaseExtractor):
+    # --sharding mesh: Megatron-style TP over attention/MLP weights plus
+    # data parallelism over the sampled-frame batch (parallel/sharding.py)
+    mesh_capable = True
+
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)
         if self.config.extract_method is None:
@@ -69,6 +73,12 @@ class ExtractCLIP(BaseExtractor):
             cast_floats_for_compute,
             compute_dtype,
         )
+        from video_features_tpu.parallel.sharding import (
+            build_sharded_apply,
+            clip_vit_param_specs,
+            is_mesh,
+            place_params,
+        )
 
         dt = compute_dtype(self.config)
         model = VisionTransformer(self.model_cfg, dtype=dt)
@@ -76,11 +86,18 @@ class ExtractCLIP(BaseExtractor):
         if dt != jnp.float32:
             # final projection stays fp32 (the 512-d embedding contract)
             params = cast_floats_for_compute(params, dt, exclude=("proj",))
-        params = jax.device_put(params, device)
 
-        @jax.jit
-        def encode_image(p, x):
-            return model.apply({"params": p}, x)
+        if is_mesh(device):
+            # one GSPMD-sharded executable: TP over attention/MLP weights,
+            # DP over the frame batch — the dryrun_multichip code path
+            params = place_params(params, device, clip_vit_param_specs)
+            encode_image = build_sharded_apply(model, device)
+        else:
+            params = jax.device_put(params, device)
+
+            @jax.jit
+            def encode_image(p, x):
+                return model.apply({"params": p}, x)
 
         return {"params": params, "encode_image": encode_image, "device": device}
 
@@ -104,8 +121,11 @@ class ExtractCLIP(BaseExtractor):
 
     # device half: transfer + jitted encode
     def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+        from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
         padded, T, fps, timestamps_ms = payload
-        x = jax.device_put(jnp.asarray(padded), state["device"])
+        padded = pad_batch_for(state["device"], padded)  # mesh: /data-divisible
+        x = place_batch(padded, state["device"])
         feats = np.asarray(state["encode_image"](state["params"], x))[:T]
         return {
             self.feature_type: feats,

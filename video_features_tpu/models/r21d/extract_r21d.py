@@ -65,6 +65,10 @@ def kinetics_preprocess(frames: jnp.ndarray) -> jnp.ndarray:
 
 
 class ExtractR21D(BaseExtractor):
+    # --sharding mesh: pure data parallelism — conv weights replicate,
+    # the window-batch axis shards over 'data' (parallel/sharding.py)
+    mesh_capable = True
+
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)
         self.stack_size = int(self.config.stack_size or DEFAULT_STACK_SIZE)
@@ -95,17 +99,22 @@ class ExtractR21D(BaseExtractor):
             compute_dtype,
         )
 
+        from video_features_tpu.parallel.sharding import (
+            jit_sharded_forward,
+            place_params,
+        )
+
         dt = compute_dtype(self.config)
         model = build(dtype=dt)
         params = self._load_host_params()
         if dt != jnp.float32:
             params = cast_floats_for_compute(params, dt, exclude=("fc",))
-        params = jax.device_put(params, device)
+        params = place_params(params, device)  # mesh: replicated (DP)
 
-        @jax.jit
         def forward(p, stacks_uint8):  # (B, stack, H, W, 3) uint8
             return model.apply({"params": p}, kinetics_preprocess(stacks_uint8))
 
+        forward = jit_sharded_forward(forward, device, n_out=2)
         return {"params": params, "forward": forward, "device": device}
 
     # host half: whole-clip decode + uint8 window batching (runs on
@@ -133,9 +142,12 @@ class ExtractR21D(BaseExtractor):
         if not slices:
             return {self.feature_type: np.zeros((0, R21D_FEATURE_DIM), np.float32)}
 
+        from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
         feats_out, logits_out = [], []
         for padded, n in batches:
-            x = jax.device_put(jnp.asarray(padded), state["device"])
+            padded = pad_batch_for(state["device"], padded)
+            x = place_batch(padded, state["device"])
             feats, logits = state["forward"](state["params"], x)
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:

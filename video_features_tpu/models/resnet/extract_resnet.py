@@ -33,6 +33,10 @@ from video_features_tpu.utils.labels import show_predictions_on_dataset
 
 
 class ExtractResNet(BaseExtractor):
+    # --sharding mesh: pure data parallelism — conv weights replicate,
+    # the frame-batch axis shards over 'data' (parallel/sharding.py)
+    mesh_capable = True
+
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)
         self.batch_size = max(int(self.config.batch_size or 1), 1)
@@ -62,17 +66,22 @@ class ExtractResNet(BaseExtractor):
             compute_dtype,
         )
 
+        from video_features_tpu.parallel.sharding import (
+            jit_sharded_forward,
+            place_params,
+        )
+
         dt = compute_dtype(self.config)
         model = build(self.feature_type, dtype=dt)
         params = self._load_host_params()
         if dt != jnp.float32:
             params = cast_floats_for_compute(params, dt, exclude=("fc",))
-        params = jax.device_put(params, device)
+        params = place_params(params, device)  # mesh: replicated (DP)
 
-        @jax.jit
         def forward(p, x):
             return model.apply({"params": p}, x)
 
+        forward = jit_sharded_forward(forward, device, n_out=2)
         return {"params": params, "forward": forward, "device": device}
 
     def _decide_native(self) -> None:
@@ -167,11 +176,14 @@ class ExtractResNet(BaseExtractor):
         timestamps_ms: List[float] = []
 
         def run(batch):
+            from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
             n = len(batch)
             x = self._preprocess_batch(batch)
             if n < self.batch_size:
                 x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
-            x = jax.device_put(jnp.asarray(x), state["device"])
+            x = pad_batch_for(state["device"], x)
+            x = place_batch(x, state["device"])
             feats, logits = state["forward"](state["params"], x)
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:
@@ -200,10 +212,13 @@ class ExtractResNet(BaseExtractor):
     def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
         if payload[0] == "stream":
             return self._extract_streaming(state, payload[1])
+        from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
         batches, counts, actual_fps, timestamps_ms = payload
         feats_out: List[np.ndarray] = []
         for x, n in zip(batches, counts):
-            x = jax.device_put(jnp.asarray(x), state["device"])
+            x = pad_batch_for(state["device"], x)
+            x = place_batch(x, state["device"])
             feats, logits = state["forward"](state["params"], x)
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:

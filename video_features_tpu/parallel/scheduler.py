@@ -23,6 +23,35 @@ import traceback
 from typing import List, Optional, Sequence
 
 
+def mesh_feature_extraction(extractor, devices: Optional[Sequence] = None) -> None:
+    """``--sharding mesh``: one GSPMD-sharded executable over every
+    selected device instead of one replica per device.
+
+    Builds a (data, model) ``jax.sharding.Mesh`` (``--mesh_model`` sets the
+    tensor-parallel axis; the frame/stack batch shards over 'data') and
+    runs the ordinary extraction loop with the mesh as the extractor's
+    "device" — the same ``build_sharded_apply`` path the driver's
+    ``dryrun_multichip`` validates. The decode pipeline (--decode_workers)
+    still overlaps host work with the sharded compute.
+    """
+    from video_features_tpu.parallel.devices import resolve_devices
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    if devices is None:
+        devices = resolve_devices(extractor.config)
+    if not getattr(extractor, "mesh_capable", False):
+        raise ValueError(
+            f"--sharding mesh is not supported for feature_type "
+            f"{extractor.feature_type!r}: {type(extractor).__name__} does "
+            "not declare mesh support (mesh_capable); use --sharding queue"
+        )
+    mesh = make_mesh(devices, model=int(extractor.config.mesh_model or 1))
+    try:
+        extractor(device=mesh)
+    finally:
+        extractor.progress.close()
+
+
 def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -> None:
     """Extract features for every video in ``extractor.path_list``.
 

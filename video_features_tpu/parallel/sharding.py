@@ -100,3 +100,65 @@ def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data")):
         return model.apply({"params": p}, x)
 
     return fn
+
+
+# --- product-path helpers (--sharding mesh) ------------------------------
+#
+# Extractor ``_build(device)`` receives either a single jax.Device (queue
+# mode, one executable per chip) or a Mesh (mesh mode, one GSPMD-sharded
+# executable spanning every chip). These helpers let one _build body serve
+# both without branching at every call site.
+
+
+def is_mesh(device) -> bool:
+    return isinstance(device, Mesh)
+
+
+def place_params(params, device, specs_fn=None):
+    """Put a host param tree on a device — or shard it over a mesh.
+
+    ``specs_fn(params) -> spec tree`` supplies the mesh layout (e.g.
+    ``clip_vit_param_specs`` for Megatron-style TP); None replicates every
+    leaf (pure data parallelism — the right default for conv nets whose
+    weights are small next to activations)."""
+    if not is_mesh(device):
+        return jax.device_put(params, device)
+    if specs_fn is None:
+        specs = jax.tree.map(lambda _: P(), params)
+    else:
+        specs = specs_fn(params)
+    return shard_params(params, device, specs)
+
+
+def pad_batch_for(device, batch: np.ndarray) -> np.ndarray:
+    """Round axis 0 up so the mesh 'data' axis divides it (queue mode:
+    no-op). Pad rows compute garbage that the caller slices off via its own
+    row count — cheaper than uneven-sharding gymnastics."""
+    n = batch.shape[0]
+    if not is_mesh(device):
+        return batch
+    data = device.shape["data"]
+    to = -(-n // data) * data
+    if to != n:
+        pad = [(0, to - n)] + [(0, 0)] * (batch.ndim - 1)
+        batch = np.pad(batch, pad)
+    return batch
+
+
+def jit_sharded_forward(fn, device, n_out: int = 1):
+    """jit ``fn(params, x)`` for either execution mode: plain jit on a
+    single device; on a Mesh, pin each output to P('data') so results come
+    back batch-sharded (params/input shardings flow in as arguments)."""
+    if not is_mesh(device):
+        return jax.jit(fn)
+    out = NamedSharding(device, P("data"))
+    return jax.jit(fn, out_shardings=out if n_out == 1 else (out,) * n_out)
+
+
+def place_batch(x, device, spec=P("data")):
+    """Transfer one input batch: device_put for a single device, sharded
+    device_put over the mesh (axis 0 must already divide — see
+    ``pad_batch_for``)."""
+    if not is_mesh(device):
+        return jax.device_put(x, device)
+    return jax.device_put(x, NamedSharding(device, spec))
