@@ -25,6 +25,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# ... and pin the ENV VAR too: entry points re-assert the platform from it
+# (parallel/devices.py::pin_platform), and on axon hosts JAX_PLATFORMS=axon
+# would flip the whole suite from the 8 virtual CPU devices to the one real
+# chip the moment a test drives main().
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 import pytest

@@ -107,6 +107,29 @@ def test_sharded_clip_matches_single_device():
     ))
 
 
+def test_real_width_clip_tp_matches_single_device():
+    """TP at the REAL ViT-B/32 width (768, 12 heads) — model=4 splits
+    each 64-d head group across chips; features must match the unsharded
+    graph (model=2 at real width is covered end-to-end by
+    test_mesh_cli_matches_queue_outputs)."""
+    from video_features_tpu.models.clip.model import (
+        CLIP_VIT_B32,
+        VisionTransformer,
+        init_params,
+    )
+
+    model = VisionTransformer(CLIP_VIT_B32)
+    params = init_params(CLIP_VIT_B32)
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(8, 3, 224, 224).astype(np.float32)
+    )
+    ref = np.asarray(jax.jit(lambda p, v: model.apply({"params": p}, v))(params, x))
+    mesh = make_mesh(jax.devices(), model=4)
+    out = build_sharded_apply(model, mesh)(shard_params(params, mesh), x)
+    assert out.shape == (8, 512)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+
 def test_graft_dryrun_multichip():
     import __graft_entry__
 
@@ -226,6 +249,26 @@ def test_mesh_rejects_unsupported_feature_type(sample_video, tmp_path):
     ex = ExtractRAFT(cfg)
     ex.progress.disable = True
     with pytest.raises(ValueError, match="sharding mesh"):
+        mesh_feature_extraction(ex, jax.devices())
+
+
+def test_mesh_model_axis_rejected_for_dp_only_models(sample_video, tmp_path):
+    """--mesh_model > 1 on a DP-only model would silently replicate work
+    across the 'model' axis; it must be refused, not degraded."""
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+    from video_features_tpu.parallel.scheduler import mesh_feature_extraction
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="r21d_rgb",
+        video_paths=[sample_video],
+        mesh_model=2,
+        tmp_path=str(tmp_path / "t"),
+        output_path=str(tmp_path / "o"),
+    )
+    ex = ExtractR21D(cfg)
+    ex.progress.disable = True
+    with pytest.raises(ValueError, match="tensor-parallel"):
         mesh_feature_extraction(ex, jax.devices())
 
 

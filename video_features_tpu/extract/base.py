@@ -37,6 +37,9 @@ class BaseExtractor:
     # True when _build accepts a jax.sharding.Mesh as ``device`` and runs
     # one GSPMD-sharded executable over it (--sharding mesh).
     mesh_capable: bool = False
+    # True when the extractor additionally defines tensor-parallel param
+    # specs, i.e. --mesh_model > 1 shards weights instead of replicating.
+    mesh_tp_capable: bool = False
 
     def __init__(self, config, external_call: bool = False) -> None:
         self.config = as_config(config)

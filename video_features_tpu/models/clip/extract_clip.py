@@ -41,6 +41,7 @@ class ExtractCLIP(BaseExtractor):
     # --sharding mesh: Megatron-style TP over attention/MLP weights plus
     # data parallelism over the sampled-frame batch (parallel/sharding.py)
     mesh_capable = True
+    mesh_tp_capable = True  # clip_vit_param_specs shard the 'model' axis
 
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)

@@ -39,14 +39,23 @@ def mesh_feature_extraction(extractor, devices: Optional[Sequence] = None) -> No
 
     if devices is None:
         devices = resolve_devices(extractor.config)
-    if not getattr(extractor, "mesh_capable", False):
-        raise ValueError(
-            f"--sharding mesh is not supported for feature_type "
-            f"{extractor.feature_type!r}: {type(extractor).__name__} does "
-            "not declare mesh support (mesh_capable); use --sharding queue"
-        )
-    mesh = make_mesh(devices, model=int(extractor.config.mesh_model or 1))
     try:
+        if not getattr(extractor, "mesh_capable", False):
+            raise ValueError(
+                f"--sharding mesh is not supported for feature_type "
+                f"{extractor.feature_type!r}: {type(extractor).__name__} does "
+                "not declare mesh support (mesh_capable); use --sharding queue"
+            )
+        model_axis = int(extractor.config.mesh_model or 1)
+        if model_axis > 1 and not getattr(extractor, "mesh_tp_capable", False):
+            # DP-only models replicate params: chips along 'model' would
+            # redo identical work while looking busy. Refuse loudly.
+            raise ValueError(
+                f"--mesh_model {model_axis} needs tensor-parallel param "
+                f"specs, which {type(extractor).__name__} does not define "
+                "(only the batch axis shards); use --mesh_model 1"
+            )
+        mesh = make_mesh(devices, model=model_axis)
         extractor(device=mesh)
     finally:
         extractor.progress.close()
