@@ -1,14 +1,22 @@
 #!/usr/bin/env python
-"""End-to-end throughput benchmark: videos/sec/chip, CLIP-ViT-B/32 uni_12.
+"""End-to-end throughput benchmarks for both BASELINE.md north-star
+configs, printed as ONE JSON line.
 
-The reference publishes no numbers (BASELINE.md) — its pipeline on GPU is
-decode-bound single-threaded per device. The nominal baseline below (1.0
-videos/s/device for the full decode->preprocess->encode->fetch loop on
-a short clip) stands in for that unpublished number until a measured
-reference run replaces it; ``vs_baseline`` is value/nominal.
+- headline: videos/sec/chip, CLIP-ViT-B/32 uni_12 (decode -> preprocess ->
+  encode -> fetch), comparable round over round (BENCH_r01 = 3.637 on the
+  real chip).
+- extra.i3d_raft_vps: videos/sec/chip for the deep pipeline — I3D rgb+flow
+  over 64-frame stacks with RAFT (20 GRU iters) computing flow on the fly.
+- extra.pallas_corr_speedup_vs_xla: the PWC cost-volume microbench, Pallas
+  VMEM-tiled kernel vs the XLA shifted-reduce formulation (TPU backends
+  only; omitted on CPU where the Pallas kernel has no fast path).
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "videos/s", "vs_baseline": N}
+``vs_baseline`` ratios divide by MEASURED numbers — the reference's own
+torch code timed on this host's CPU by scripts/measure_baseline.py
+(provenance in BASELINE.md; the reference cannot run on TPU and publishes
+no numbers of its own, BASELINE.md "Published reference numbers"). Set
+BENCH_MEASURE_BASELINE=1 to re-measure them live instead of using the
+recorded values.
 """
 
 from __future__ import annotations
@@ -21,48 +29,165 @@ import time
 
 import numpy as np
 
-NOMINAL_BASELINE_VPS = 1.0  # unpublished reference throughput stand-in
+# Measured by scripts/measure_baseline.py (reference torch code on this
+# host's CPU — a SINGLE core on the bench VM; the reference's CUDA/cupy
+# path cannot run here at all). Provenance in BASELINE.md "Measured
+# baselines"; re-measure with BENCH_MEASURE_BASELINE=1.
+MEASURED_BASELINES = {
+    "clip_torch_cpu_vps": 0.91,        # 2026-07-29, host 'vm', 1 CPU core
+    "i3d_raft_torch_cpu_vps": 0.0029,  # ~345 s/video (140 frames, 2 stacks)
+}
 
 
-def main() -> None:
+def _load_measured_baselines() -> dict:
+    if os.environ.get("BENCH_MEASURE_BASELINE") == "1":
+        import subprocess
+
+        argv = [sys.executable, os.path.join(os.path.dirname(__file__),
+                                             "scripts", "measure_baseline.py"),
+                "--videos", os.environ.get("BENCH_VIDEOS", "16")]
+        if os.environ.get("BENCH_SKIP_I3D") == "1":
+            argv.append("--skip-i3d")
+        out = subprocess.run(
+            argv, capture_output=True, text=True, check=True,
+        ).stdout.strip().splitlines()[-1]
+        return json.loads(out)
+    return MEASURED_BASELINES
+
+
+def bench_clip(n_videos: int, video: str, tmp: str) -> float:
     from video_features_tpu.config import ExtractionConfig
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
     from video_features_tpu.parallel.devices import resolve_devices
 
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="CLIP-ViT-B/32",
+        video_paths=[video] * n_videos,
+        extract_method="uni_12",
+        tmp_path=os.path.join(tmp, "t"),
+        output_path=os.path.join(tmp, "o"),
+    )
+    ex = ExtractCLIP(cfg, external_call=True)
+    ex.progress.disable = True
+    device = resolve_devices(cfg)[0]
+    ex([0], device=device)  # warmup: decode path + XLA compile
+    t0 = time.perf_counter()
+    results = ex(range(n_videos), device=device)
+    dt = time.perf_counter() - t0
+    assert len(results) == n_videos and all(
+        r["CLIP-ViT-B/32"].shape == (12, 512) for r in results
+    )
+    return n_videos / dt
+
+
+def bench_i3d_raft(video: str, tmp: str) -> float:
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+    from video_features_tpu.parallel.devices import resolve_devices
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="i3d",
+        flow_type="raft",
+        video_paths=[video],
+        tmp_path=os.path.join(tmp, "t"),
+        output_path=os.path.join(tmp, "o"),
+    )
+    ex = ExtractI3D(cfg, external_call=True)
+    ex.progress.disable = True
+    device = resolve_devices(cfg)[0]
+    ex([0], device=device)  # warmup: RAFT scan + two I3D towers compile
+    t0 = time.perf_counter()
+    (r,) = ex([0], device=device)
+    dt = time.perf_counter() - t0
+    assert r["rgb"].shape[1] == 1024 and r["flow"].shape[1] == 1024
+    return 1.0 / dt
+
+
+def bench_pallas_corr() -> dict:
+    """PWC 81-channel cost volume: Pallas kernel vs XLA formulation on the
+    hottest PWC shape (level 2: 64 pairs, 32ch, 64x64 — the level 'auto'
+    routes to the Pallas kernel). K calls chain inside one jitted scan so
+    per-dispatch tunnel latency (~25 ms on axon) doesn't swamp the
+    kernel-scale times."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.ops.correlation import local_correlation
+
+    if jax.default_backend() != "tpu":
+        return {}
+    N, C, H, W = 64, 32, 64, 64
+    K = 50
+    rng = np.random.RandomState(0)
+    f1 = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32))
+    f2 = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32))
+
+    def timed(method):
+        @jax.jit
+        def fn(a, b):
+            def body(carry, _):
+                acc, a = carry
+                out = local_correlation(a, b, method=method)
+                return (acc + jnp.sum(out), jnp.roll(a, 1, axis=0)), None
+
+            (acc, _), _ = jax.lax.scan(body, (0.0, a), None, length=K)
+            return acc
+
+        float(fn(f1, f2))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn(f1, f2))
+            best = min(best, time.perf_counter() - t0)
+        return best / K
+
+    t_pallas, t_xla = timed("pallas"), timed("xla")
+    return {
+        "pallas_corr_us": round(t_pallas * 1e6, 1),
+        "xla_corr_us": round(t_xla * 1e6, 1),
+        "pallas_corr_speedup_vs_xla": round(t_xla / t_pallas, 3),
+    }
+
+
+def main() -> None:
     from video_features_tpu.utils.synth import synth_video
 
     n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
+    baselines = _load_measured_baselines()
+    extra = {}
     with tempfile.TemporaryDirectory() as tmp:
-        video = synth_video(
+        clip_video = synth_video(
             os.path.join(tmp, "bench.mp4"), n_frames=120, width=640, height=360
         )
-        cfg = ExtractionConfig(
-            allow_random_init=True,
-            feature_type="CLIP-ViT-B/32",
-            video_paths=[video] * n_videos,
-            extract_method="uni_12",
-            tmp_path=os.path.join(tmp, "t"),
-            output_path=os.path.join(tmp, "o"),
+        i3d_video = synth_video(
+            os.path.join(tmp, "i3d.mp4"), n_frames=140, width=256, height=256
         )
-        ex = ExtractCLIP(cfg, external_call=True)
-        ex.progress.disable = True
-        device = resolve_devices(cfg)[0]
-        ex([0], device=device)  # warmup: decode path + XLA compile
-        t0 = time.perf_counter()
-        results = ex(range(n_videos), device=device)
-        dt = time.perf_counter() - t0
-        assert len(results) == n_videos and all(
-            r["CLIP-ViT-B/32"].shape == (12, 512) for r in results
-        )
+        clip_vps = bench_clip(n_videos, clip_video, tmp)
+        if os.environ.get("BENCH_SKIP_I3D") != "1":
+            extra["i3d_raft_vps"] = round(bench_i3d_raft(i3d_video, tmp), 3)
+        extra.update(bench_pallas_corr())
 
-    vps = n_videos / dt
+    clip_base = baselines.get("clip_torch_cpu_vps")
+    i3d_base = baselines.get("i3d_raft_torch_cpu_vps")
+    if clip_base:
+        extra["clip_torch_cpu_vps"] = clip_base
+    if i3d_base and "i3d_raft_vps" in extra:
+        extra["i3d_raft_torch_cpu_vps"] = i3d_base
+        extra["i3d_raft_vs_torch_cpu"] = round(extra["i3d_raft_vps"] / i3d_base, 3)
+    extra["baseline_provenance"] = (
+        "reference torch code on this host's CPU (scripts/measure_baseline.py; "
+        "BASELINE.md 'Measured baselines')"
+    )
     print(
         json.dumps(
             {
                 "metric": "videos/sec/chip (CLIP-ViT-B/32, uni_12, end-to-end)",
-                "value": round(vps, 3),
+                "value": round(clip_vps, 3),
                 "unit": "videos/s",
-                "vs_baseline": round(vps / NOMINAL_BASELINE_VPS, 3),
+                "vs_baseline": round(clip_vps / clip_base, 3) if clip_base else None,
+                "extra": extra,
             }
         )
     )

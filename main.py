@@ -19,7 +19,22 @@ from video_features_tpu.parallel.scheduler import (
 
 
 def main(argv=None) -> None:
+    import os
+
     cfg = parse_args(argv)
+
+    # Multi-host slices: when a launcher provides a coordinator (e.g.
+    # JAX_COORDINATOR_ADDRESS on a TPU pod), join the distributed runtime
+    # before touching devices — jax.devices() then spans hosts and a
+    # --sharding mesh rides ICI for collectives, DCN for dispatch. After
+    # arg validation (a --help/typo run must not block on the barrier),
+    # never for --cpu, and only once per process (initialize is once-only).
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and not cfg.cpu:
+        import jax
+
+        if not getattr(main, "_distributed_initialized", False):
+            jax.distributed.initialize()
+            main._distributed_initialized = True
     if cfg.on_extraction in ("save_numpy", "save_pickle"):
         print(f"Saving features to {cfg.output_path}")
     if cfg.keep_tmp_files:

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Convert reference-ecosystem checkpoints to flax ``.msgpack`` once,
+ahead of time — the offline analog of the reference's auto-download paths
+(pip clip / torch-hub / gcs wget, ref models/vggish_torch/extract_vggish.py:22-27,
+SURVEY.md §2 item 21), which a zero-egress TPU host cannot use.
+
+Extractors consume either format at --weights_path; pre-converting skips
+the torch-unpickle + layout conversion on every run and drops the torch
+dependency from the serving host.
+
+Usage:
+  python scripts/convert_weights.py --feature_type resnet50 \
+      resnet50-0676ba61.pth resnet50.msgpack
+  python scripts/convert_weights.py --feature_type i3d --stream flow \
+      i3d_flow.pt i3d_flow.msgpack
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def convert_fn(feature_type: str, stream: str | None):
+    """The family's state-dict -> param-tree converter (a closure over any
+    per-family config)."""
+    from video_features_tpu.config import CLIP_FEATURE_TYPES, RESNET_FEATURE_TYPES
+
+    if feature_type in CLIP_FEATURE_TYPES:
+        from video_features_tpu.models.clip.convert import convert_state_dict
+        from video_features_tpu.models.clip.model import CONFIGS
+
+        return lambda sd: convert_state_dict(sd, CONFIGS[feature_type].layers)
+    if feature_type in RESNET_FEATURE_TYPES:
+        from video_features_tpu.models.resnet.convert import convert_state_dict
+
+        return lambda sd: convert_state_dict(sd, feature_type)
+    if feature_type == "r21d_rgb":
+        from video_features_tpu.models.r21d.convert import convert_state_dict
+
+        return convert_state_dict
+    if feature_type == "raft":
+        from video_features_tpu.models.raft.convert import convert_state_dict
+
+        return convert_state_dict
+    if feature_type == "pwc":
+        from video_features_tpu.models.pwc.convert import convert_state_dict
+
+        return convert_state_dict
+    if feature_type == "i3d":
+        if stream not in ("rgb", "flow"):
+            raise SystemExit(
+                "--feature_type i3d needs --stream rgb|flow (one checkpoint "
+                "per stream; convert raft/pwc checkpoints separately under "
+                "their own feature types)"
+            )
+        from video_features_tpu.models.i3d.convert import convert_state_dict
+
+        return convert_state_dict
+    if feature_type in ("vggish", "vggish_torch"):
+        from video_features_tpu.models.vggish.convert import convert_state_dict
+
+        return convert_state_dict
+    raise SystemExit(f"unknown feature_type: {feature_type}")
+
+
+def main() -> None:
+    from video_features_tpu.config import FEATURE_TYPES
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--feature_type", required=True, choices=FEATURE_TYPES)
+    ap.add_argument("--stream", choices=["rgb", "flow"], default=None,
+                    help="i3d only: which stream this checkpoint is")
+    ap.add_argument("src", help="source checkpoint (.pt/.pth/.pytorch/.bin/.npz)")
+    ap.add_argument("dst", help="output .msgpack path")
+    args = ap.parse_args()
+
+    if not args.dst.endswith(".msgpack"):
+        raise SystemExit(f"dst must end in .msgpack, got {args.dst}")
+
+    from flax import serialization
+
+    from video_features_tpu.models.common.weights import load_params
+
+    params = load_params(args.src, convert_fn(args.feature_type, args.stream))
+    blob = serialization.msgpack_serialize(params)
+    tmp = args.dst + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, args.dst)
+    import jax
+
+    n = sum(x.size for x in jax.tree.leaves(params))
+    print(f"{args.src} -> {args.dst}: {n / 1e6:.1f}M params")
+
+
+if __name__ == "__main__":
+    main()

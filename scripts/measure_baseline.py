@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Measure the REFERENCE's own torch code on this host's CPU — the
+measured denominators behind bench.py's ``vs_baseline`` (replacing the
+round-1 fabricated ``NOMINAL_BASELINE_VPS``; results recorded with
+provenance in BASELINE.md).
+
+The reference cannot run end-to-end in this environment (its CLIP needs
+the pip ``clip`` package, its decode needs mmcv, its PWC correlation is
+CUDA-only), and it targets CUDA GPUs which this host does not have. What
+CAN be measured honestly is its compute path on the CPU both frameworks
+share:
+
+- CLIP config: uni_12 cv2 decode + the reference's PIL
+  resize/crop/normalize chain + a torch ViT-B/32 vision tower
+  (transformers' CLIPVisionModelWithProjection — the same graph the pip
+  ``clip`` package builds; random init, which does not change throughput).
+- I3D+RAFT config: the reference's actual model sources
+  (/root/reference/models/raft/raft_src/raft.py, iters=20, and
+  /root/reference/models/i3d/i3d_src/i3d_net.py rgb+flow), driven with
+  the reference's _run_on_a_stack windowing (ref
+  models/i3d/extract_i3d.py:160-193): 64-pair RAFT per 65-frame stack,
+  center-crop 224, flow clamp->uint8->[-1,1] quantization, both I3D
+  streams.
+
+Decode for both sides uses the same cv2 path (mmcv is unavailable), so
+the comparison isolates framework+compute, not decoder brands.
+
+Run: python scripts/measure_baseline.py [--videos N] [--skip-i3d]
+Prints one JSON dict; paste the numbers + provenance into BASELINE.md and
+bench.py's MEASURED_BASELINES.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _ref_import(name):
+    import importlib
+
+    if REF not in sys.path:
+        sys.path.append(REF)
+    return importlib.import_module(name)
+
+
+def measure_clip_torch_cpu(videos) -> float:
+    """Reference-equivalent CLIP pipeline in torch on CPU -> videos/s."""
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModelWithProjection
+
+    from video_features_tpu.io.video import extract_frames
+    from video_features_tpu.ops.preprocess import (
+        CLIP_MEAN,
+        CLIP_STD,
+        normalize_chw,
+        pil_center_crop,
+        pil_resize,
+        to_float_chw,
+    )
+    from PIL import Image
+
+    cfg = CLIPVisionConfig(
+        hidden_size=768,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        intermediate_size=3072,
+        image_size=224,
+        patch_size=32,
+        projection_dim=512,
+        hidden_act="quick_gelu",
+    )
+    torch.manual_seed(0)
+    model = CLIPVisionModelWithProjection(cfg).eval()
+
+    def one(path):
+        frames, fps, ts = extract_frames(path, "uni_12")
+        batch = np.stack(
+            [
+                normalize_chw(
+                    to_float_chw(
+                        pil_center_crop(
+                            pil_resize(f, 224, interpolation=Image.BICUBIC), 224
+                        )
+                    ),
+                    CLIP_MEAN,
+                    CLIP_STD,
+                )
+                for f in frames
+            ]
+        )
+        with torch.no_grad():
+            out = model(pixel_values=torch.from_numpy(batch)).image_embeds
+        return out.numpy()
+
+    one(videos[0])  # warmup (allocator, thread pool)
+    t0 = time.perf_counter()
+    for v in videos:
+        feats = one(v)
+        assert feats.shape == (12, 512)
+    return len(videos) / (time.perf_counter() - t0)
+
+
+def measure_i3d_raft_torch_cpu(video) -> float:
+    """The reference's raft_src + i3d_src driven with its I3D stack loop
+    on CPU -> videos/s (one video, typically 2 stacks)."""
+    import torch
+
+    from video_features_tpu.io.video import read_all_frames
+
+    raft_mod = _ref_import("models.raft.raft_src.raft")
+    i3d_mod = _ref_import("models.i3d.i3d_src.i3d_net")
+    torch.manual_seed(0)
+    raft = raft_mod.RAFT().eval()
+    i3d_rgb = i3d_mod.I3D(num_classes=400, modality="rgb").eval()
+    i3d_flow = i3d_mod.I3D(num_classes=400, modality="flow").eval()
+
+    t0 = time.perf_counter()
+    frames, _, _ = read_all_frames(video, None)
+    import cv2
+
+    # min-side 256 resize (ref i3d/transforms ResizeImproved); synth video
+    # is square so this is a plain resize
+    rs = [cv2.resize(f, (256, 256), interpolation=cv2.INTER_LINEAR) for f in frames]
+    clip = torch.from_numpy(np.stack(rs)).permute(0, 3, 1, 2).float()  # (T,3,256,256)
+
+    stack, step = 64, 64
+    n_stacks = 0
+    with torch.no_grad():
+        for s in range(0, clip.shape[0] - stack, step):
+            window = clip[s : s + stack + 1]
+            flow = raft(window[:-1], window[1:], iters=20, test_mode=True)
+            # center crop 224 + reference transform chains
+            rgb = window[:-1, :, 16:240, 16:240]
+            fl = flow[:, :, 16:240, 16:240]
+            rgb = (2.0 * rgb / 255.0) - 1.0  # scale_to_1_1 after /255
+            fl = torch.clamp(fl, -20, 20)
+            fl = torch.floor(128 + 255.0 / 40.0 * fl).clamp(0, 255)  # ToUInt8
+            fl = (2.0 * fl / 255.0) - 1.0
+            feats_rgb = i3d_rgb(
+                rgb.permute(1, 0, 2, 3).unsqueeze(0), features=True
+            )
+            feats_flow = i3d_flow(
+                fl.permute(1, 0, 2, 3).unsqueeze(0), features=True
+            )
+            assert feats_rgb.shape == feats_flow.shape == (1, 1024)
+            n_stacks += 1
+    dt = time.perf_counter() - t0
+    assert n_stacks >= 1
+    return 1.0 / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--videos", type=int, default=8, help="CLIP-config videos")
+    ap.add_argument("--skip-i3d", action="store_true")
+    ap.add_argument("--skip-clip", action="store_true")
+    args = ap.parse_args()
+
+    from video_features_tpu.utils.synth import synth_video
+
+    out = {"host": os.uname().nodename, "cpu_count": os.cpu_count()}
+    with tempfile.TemporaryDirectory() as tmp:
+        # the same synth specs bench.py uses
+        clip_video = synth_video(
+            os.path.join(tmp, "clip.mp4"), n_frames=120, width=640, height=360
+        )
+        i3d_video = synth_video(
+            os.path.join(tmp, "i3d.mp4"), n_frames=140, width=256, height=256
+        )
+        if not args.skip_clip:
+            out["clip_torch_cpu_vps"] = round(
+                measure_clip_torch_cpu([clip_video] * args.videos), 4
+            )
+        if not args.skip_i3d:
+            out["i3d_raft_torch_cpu_vps"] = round(
+                measure_i3d_raft_torch_cpu(i3d_video), 4
+            )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

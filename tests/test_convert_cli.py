@@ -1,0 +1,66 @@
+"""scripts/convert_weights.py: pre-convert reference checkpoints to
+flax .msgpack (the offline replacement for the reference's auto-download
+registry, SURVEY.md §2 item 21)."""
+
+import pathlib
+import runpy
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+
+SCRIPT = str(
+    pathlib.Path(__file__).resolve().parents[1] / "scripts" / "convert_weights.py"
+)
+
+
+def _run_cli(argv):
+    old = sys.argv
+    sys.argv = ["convert_weights.py"] + argv
+    try:
+        runpy.run_path(SCRIPT, run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+def test_convert_cli_resnet_roundtrip(tmp_path, capsys):
+    """torch .pt -> msgpack via the CLI; the jitted forward must be
+    bit-identical whichever format --weights_path gets."""
+    from tests.test_resnet import _torch_oracle
+    from video_features_tpu.models.common.weights import load_params
+    from video_features_tpu.models.resnet.convert import convert_state_dict
+    from video_features_tpu.models.resnet.model import build
+
+    oracle = _torch_oracle("resnet18")
+    src = tmp_path / "resnet18.pt"
+    dst = tmp_path / "resnet18.msgpack"
+    torch.save(oracle.state_dict(), src)
+
+    _run_cli(["--feature_type", "resnet18", str(src), str(dst)])
+    assert dst.exists() and "M params" in capsys.readouterr().out
+
+    from_msgpack = load_params(str(dst), None)  # .msgpack skips the converter
+    from_pt = load_params(str(src), lambda sd: convert_state_dict(sd, "resnet18"))
+
+    x = np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32)
+    model = build("resnet18")
+    f1, _ = jax.jit(model.apply)({"params": from_pt}, x)
+    f2, _ = jax.jit(model.apply)({"params": from_msgpack}, x)
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+
+
+def test_convert_cli_i3d_requires_stream(tmp_path):
+    src = tmp_path / "w.pt"
+    torch.save({}, src)
+    with pytest.raises(SystemExit, match="stream"):
+        _run_cli(["--feature_type", "i3d", str(src), str(tmp_path / "o.msgpack")])
+
+
+def test_convert_cli_rejects_non_msgpack_dst(tmp_path):
+    src = tmp_path / "w.pt"
+    torch.save({}, src)
+    with pytest.raises(SystemExit, match="msgpack"):
+        _run_cli(["--feature_type", "resnet18", str(src), str(tmp_path / "o.npz")])

@@ -71,3 +71,37 @@ def test_extract_raft_end_to_end(sample_video, tmp_path):
     assert flow.shape[0] == 11 and flow.shape[1] == 2
     assert flow.shape[2] == 64 or flow.shape[3] == 64
     assert np.isfinite(flow).all()
+
+
+def test_lookup_corr_matches_gather_sampler():
+    """The separable one-hot-matmul window lookup (models/raft/model.py
+    lookup_corr — the MXU formulation of ref raft_src/corr.py:35-48) must
+    equal bilinear gather sampling of the same (2r+1)^2 window, including
+    zero padding at volume edges."""
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.raft.model import lookup_corr
+    from video_features_tpu.ops.sampler import bilinear_sampler
+
+    rng = np.random.RandomState(0)
+    N, H, W, r = 2, 16, 12, 4
+    levels = []
+    for lvl in range(3):
+        h, w = H >> lvl, W >> lvl
+        levels.append(jnp.asarray(rng.randn(N * H * W, h, w, 1).astype(np.float32)))
+    # coords wander past the volume edges to exercise the zero padding
+    coords = jnp.asarray(rng.uniform(-3, 18, size=(N, H, W, 2)).astype(np.float32))
+
+    got = np.asarray(lookup_corr(levels, coords, radius=r))
+
+    d = jnp.linspace(-r, r, 2 * r + 1, dtype=jnp.float32)
+    delta = jnp.stack(jnp.meshgrid(d, d, indexing="ij"), axis=-1)
+    want = []
+    for lvl, corr in enumerate(levels):
+        centroid = coords.reshape(N * H * W, 1, 1, 2) / (2 ** lvl)
+        sampled = bilinear_sampler(
+            jnp.transpose(corr, (0, 3, 1, 2)), centroid + delta[None]
+        )
+        want.append(np.asarray(sampled).reshape(N, H, W, (2 * r + 1) ** 2))
+    want = np.concatenate(want, axis=-1)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

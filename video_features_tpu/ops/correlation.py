@@ -49,13 +49,18 @@ def local_correlation(
     CUDA kernel including its 1/C normalization (ref
     pwc_src/correlation.py:106-108).
 
-    ``method``: 'auto' uses the Pallas VMEM-tiled kernel on TPU backends
-    and the XLA shifted-reduce formulation elsewhere; 'pallas'/'xla'
-    force one. The Pallas kernel is forward-only — anything needing
-    ``jax.grad`` through this op must pass method='xla'.
+    ``method``: 'auto' picks per shape on TPU backends — the Pallas
+    VMEM-tiled kernel for large spatial extents (H*W >= 4096, e.g. PWC's
+    hottest level-2 volume, where it measures ~1.7x over XLA on v5e),
+    the XLA shifted-reduce formulation for the small pyramid levels where
+    the kernel's per-tile DMA + 128-lane padding overhead dominates
+    (bench.py's microbench records both). 'pallas'/'xla' force one. The
+    Pallas kernel is forward-only — anything needing ``jax.grad`` through
+    this op must pass method='xla'.
     """
     if method == "auto":
-        method = "pallas" if jax.default_backend() == "tpu" else "xla"
+        big = fmap1.shape[2] * fmap1.shape[3] >= 4096
+        method = "pallas" if (big and jax.default_backend() == "tpu") else "xla"
     if method == "pallas":
         from video_features_tpu.ops.pallas.correlation_kernel import (
             local_correlation_pallas,
