@@ -11,7 +11,7 @@ dependency from the serving host.
 Usage:
   python scripts/convert_weights.py --feature_type resnet50 \
       resnet50-0676ba61.pth resnet50.msgpack
-  python scripts/convert_weights.py --feature_type i3d --stream flow \
+  python scripts/convert_weights.py --feature_type i3d \
       i3d_flow.pt i3d_flow.msgpack
 """
 
@@ -26,7 +26,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def convert_fn(feature_type: str, stream: str | None):
+def convert_fn(feature_type: str):
     """The family's state-dict -> param-tree converter (a closure over any
     per-family config)."""
     from video_features_tpu.config import CLIP_FEATURE_TYPES, RESNET_FEATURE_TYPES
@@ -53,12 +53,9 @@ def convert_fn(feature_type: str, stream: str | None):
 
         return convert_state_dict
     if feature_type == "i3d":
-        if stream not in ("rgb", "flow"):
-            raise SystemExit(
-                "--feature_type i3d needs --stream rgb|flow (one checkpoint "
-                "per stream; convert raft/pwc checkpoints separately under "
-                "their own feature types)"
-            )
+        # one checkpoint per stream, same layout for both (i3d_rgb.pt /
+        # i3d_flow.pt); raft/pwc flow-model checkpoints convert separately
+        # under their own feature types
         from video_features_tpu.models.i3d.convert import convert_state_dict
 
         return convert_state_dict
@@ -74,8 +71,6 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--feature_type", required=True, choices=FEATURE_TYPES)
-    ap.add_argument("--stream", choices=["rgb", "flow"], default=None,
-                    help="i3d only: which stream this checkpoint is")
     ap.add_argument("src", help="source checkpoint (.pt/.pth/.pytorch/.bin/.npz)")
     ap.add_argument("dst", help="output .msgpack path")
     args = ap.parse_args()
@@ -87,7 +82,7 @@ def main() -> None:
 
     from video_features_tpu.models.common.weights import load_params
 
-    params = load_params(args.src, convert_fn(args.feature_type, args.stream))
+    params = load_params(args.src, convert_fn(args.feature_type))
     blob = serialization.msgpack_serialize(params)
     tmp = args.dst + ".tmp"
     with open(tmp, "wb") as f:

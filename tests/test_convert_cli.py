@@ -52,13 +52,6 @@ def test_convert_cli_resnet_roundtrip(tmp_path, capsys):
     np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
 
 
-def test_convert_cli_i3d_requires_stream(tmp_path):
-    src = tmp_path / "w.pt"
-    torch.save({}, src)
-    with pytest.raises(SystemExit, match="stream"):
-        _run_cli(["--feature_type", "i3d", str(src), str(tmp_path / "o.msgpack")])
-
-
 def test_convert_cli_rejects_non_msgpack_dst(tmp_path):
     src = tmp_path / "w.pt"
     torch.save({}, src)

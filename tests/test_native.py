@@ -70,3 +70,60 @@ def test_extract_resnet_native_preprocess(sample_video, tmp_path):
     res = ExtractResNet(cfg, external_call=True)([0])
     assert res[0]["resnet18"].shape[1] == 512
     assert np.isfinite(res[0]["resnet18"]).all()
+
+
+def test_clip_chain_matches_pil_closely():
+    """The C++ BICUBIC CLIP chain vs the pip-clip-exact PIL path."""
+    from PIL import Image
+
+    from video_features_tpu.ops.preprocess import (
+        CLIP_MEAN,
+        CLIP_STD,
+        normalize_chw,
+        pil_center_crop,
+        pil_resize,
+        to_float_chw,
+    )
+
+    frames = _frames(n=3, h=360, w=640)
+
+    def pil_one(f):
+        img = pil_center_crop(pil_resize(f, 224, interpolation=Image.BICUBIC), 224)
+        return normalize_chw(to_float_chw(img), CLIP_MEAN, CLIP_STD)
+
+    ref = np.stack([pil_one(f) for f in frames])
+    out = native.clip_preprocess_batch(frames)
+    assert out.shape == ref.shape == (3, 3, 224, 224)
+    # same budget as the bilinear chain: PIL's 8-bit fixed-point filter
+    # coefficients vs float taps; bicubic overshoot makes extremes a bit
+    # wider but the scale stays ~quantization-level (normalized units)
+    diff = np.abs(out - ref)
+    assert diff.mean() < 0.02
+    assert diff.max() < 0.15
+
+
+def test_extract_clip_native_preprocess(sample_video, tmp_path):
+    """--host_preprocess native end-to-end for CLIP: same shapes, features
+    close to the PIL run (budget follows test_bfloat16-style drift, the
+    preprocess delta is ~1/255/pixel)."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    def run(mode):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="CLIP-ViT-B/32",
+            video_paths=[sample_video],
+            extract_method="uni_4",
+            host_preprocess=mode,
+            cpu=True,
+        )
+        ex = ExtractCLIP(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0])[0]["CLIP-ViT-B/32"]
+
+    pil = run("pil")
+    nat = run("native")
+    assert pil.shape == nat.shape == (4, 512)
+    # random-init features still track preprocess closely
+    denom = np.linalg.norm(pil)
+    assert np.linalg.norm(pil - nat) / max(denom, 1e-9) < 0.05

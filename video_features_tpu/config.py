@@ -99,11 +99,11 @@ class ExtractionConfig:
     # video computes (extract/base.py::_run_pipelined). 0 = fully serial
     # decode->compute, the reference's behavior.
     decode_workers: int = 2
-    # Host preprocessing backend for the PIL-chain extractors (currently
-    # the ResNet family): 'pil' reproduces the reference bit-for-bit;
-    # 'native' uses the threaded C++ library (native/preprocess.cpp,
-    # within ~1/255/pixel of PIL) for throughput. Other extractors
-    # preprocess on-device and ignore this knob.
+    # Host preprocessing backend for the PIL-chain extractors (the ResNet
+    # family's bilinear chain and CLIP's bicubic chain): 'pil' reproduces
+    # the reference bit-for-bit; 'native' uses the threaded C++ library
+    # (native/preprocess.cpp, within ~1/255/pixel of PIL) for throughput.
+    # Other extractors preprocess on-device and ignore this knob.
     host_preprocess: str = "pil"
     # Skip videos whose output files already exist (job-level resume; the
     # reference recomputes and overwrites unconditionally).

@@ -51,6 +51,7 @@ class ExtractCLIP(BaseExtractor):
             )
         self.model_cfg = CONFIGS[self.feature_type]
         self._host_params = None  # converted once, device_put per device
+        self._use_native = None  # decided (with one-time warning) on first use
 
     def _load_host_params(self):
         # called under _build_lock (warmup serializes _build calls)
@@ -108,14 +109,55 @@ class ExtractCLIP(BaseExtractor):
         img = pil_center_crop(img, size)
         return normalize_chw(to_float_chw(img), CLIP_MEAN, CLIP_STD)
 
-    # host half: decode + PIL preprocess + static-shape pad (runs on
+    def _preprocess_frames(self, frames) -> np.ndarray:
+        """Sampled frames -> (T, 3, size, size). ``--host_preprocess
+        native`` routes through the C++ BICUBIC chain (one call for the
+        whole batch, ~1/255/pixel of PIL); 'pil' is the pip-``clip``-exact
+        path. Decided once under the lock (decode workers call this
+        concurrently)."""
+        import os
+
+        with self._build_lock:
+            if self._use_native is None:
+                if self.config.host_preprocess == "native":
+                    from video_features_tpu import native
+
+                    self._use_native = native.available()
+                    if not self._use_native:
+                        print(
+                            f"native preprocess unavailable "
+                            f"({native.build_error()}); using PIL"
+                        )
+                    else:
+                        # share host cores across concurrent device workers
+                        from video_features_tpu.parallel.devices import (
+                            resolve_devices,
+                        )
+
+                        n_workers = max(len(resolve_devices(self.config)), 1)
+                        self._native_threads = max(
+                            (os.cpu_count() or 1) // n_workers, 1
+                        )
+                else:
+                    self._use_native = False
+        if self._use_native:
+            from video_features_tpu import native
+
+            return native.clip_preprocess_batch(
+                np.stack(frames),
+                size=self.model_cfg.image_size,
+                threads=self._native_threads,
+            )
+        return np.stack([self._preprocess(f) for f in frames])
+
+    # host half: decode + preprocess + static-shape pad (runs on
     # --decode_workers threads under the async pipeline)
     def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
         frames, fps, timestamps_ms = extract_frames(
             video_path, self.config.extract_method
         )
-        batch = np.stack([self._preprocess(f) for f in frames])  # (T, 3, H, W)
+        batch = self._preprocess_frames(frames)  # (T, 3, H, W)
         T = batch.shape[0]
         padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
         return padded, T, fps, timestamps_ms

@@ -77,6 +77,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float), ctypes.c_int,
         ]
         lib.imagenet_preprocess_batch.restype = None
+        lib.clip_preprocess_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        lib.clip_preprocess_batch.restype = None
         _lib = lib
         return _lib
 
@@ -117,6 +124,41 @@ def imagenet_preprocess_batch(
     lib.imagenet_preprocess_batch(
         frames.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n, h, w, resize_to, crop,
+        mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        threads,
+    )
+    return out
+
+
+def clip_preprocess_batch(
+    frames: np.ndarray,
+    size: int = 224,
+    mean: Sequence[float] = (0.48145466, 0.4578275, 0.40821073),
+    std: Sequence[float] = (0.26862954, 0.26130258, 0.27577711),
+    threads: int = 0,
+) -> np.ndarray:
+    """(N, H, W, 3) uint8 frames -> (N, 3, size, size) float32 via the
+    CLIP chain (BICUBIC smaller-edge resize, center crop, CLIP normalize;
+    within ~1/255 per pixel of the pip ``clip`` package's PIL preprocess)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native preprocess unavailable: {_build_error}")
+    frames = np.ascontiguousarray(frames, dtype=np.uint8)
+    if frames.ndim != 4 or frames.shape[-1] != 3:
+        raise ValueError(f"expected (N, H, W, 3) uint8, got {frames.shape}")
+    n, h, w, _ = frames.shape
+    if min(h, w) < 1 or size < 1:
+        raise ValueError(f"bad sizes: frame {h}x{w}, size {size}")
+    out = np.empty((n, 3, size, size), np.float32)
+    mean_a = np.ascontiguousarray(mean, np.float32)
+    std_a = np.ascontiguousarray(std, np.float32)
+    if threads <= 0:
+        threads = min(max(os.cpu_count() or 1, 1), 16)
+    lib.clip_preprocess_batch(
+        frames.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, size,
         mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

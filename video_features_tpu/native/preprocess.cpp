@@ -28,11 +28,26 @@ struct Tap {
     int coeff_off;   // offset into the coefficient array
 };
 
-// PIL-style antialiased triangle-filter taps for size in -> out.
-void build_taps(int in_size, int out_size, std::vector<Tap>& taps,
+// filter kernels, PIL semantics: 0 = BILINEAR (triangle, support 1),
+// 1 = BICUBIC (Keys a=-0.5, support 2)
+double filter_weight(int filter, double x) {
+    x = std::abs(x);
+    if (filter == 1) {
+        const double a = -0.5;
+        if (x < 1.0) return ((a + 2.0) * x - (a + 3.0)) * x * x + 1.0;
+        if (x < 2.0) return (((x - 5.0) * x + 8.0) * x - 4.0) * a;
+        return 0.0;
+    }
+    return x < 1.0 ? 1.0 - x : 0.0;
+}
+
+// PIL-style antialiased filter taps for size in -> out (support scales
+// with the downsampling ratio, half-pixel centers).
+void build_taps(int in_size, int out_size, int filter, std::vector<Tap>& taps,
                 std::vector<float>& coeffs) {
     const double scale = static_cast<double>(in_size) / out_size;
-    const double support = scale < 1.0 ? 1.0 : scale;
+    const double fscale = scale < 1.0 ? 1.0 : scale;
+    const double support = (filter == 1 ? 2.0 : 1.0) * fscale;
     taps.resize(out_size);
     coeffs.clear();
     for (int i = 0; i < out_size; ++i) {
@@ -44,17 +59,24 @@ void build_taps(int in_size, int out_size, std::vector<Tap>& taps,
         Tap t{lo, hi - lo, static_cast<int>(coeffs.size())};
         double total = 0.0;
         for (int j = lo; j < hi; ++j) {
-            const double x = (j + 0.5 - center) / (scale < 1.0 ? 1.0 : scale);
-            const double w = x > -1.0 && x < 1.0 ? 1.0 - std::abs(x) : 0.0;
+            const double w = filter_weight(filter, (j + 0.5 - center) / fscale);
             coeffs.push_back(static_cast<float>(w));
             total += w;
         }
-        if (total > 0.0) {
+        if (total != 0.0) {
             for (int j = 0; j < t.n; ++j)
                 coeffs[t.coeff_off + j] /= static_cast<float>(total);
         }
         taps[i] = t;
     }
+}
+
+// PIL rounds + clips to uint8 BETWEEN the separable passes and after the
+// final one (ImagingResample's 8bpc path) — with bicubic's negative
+// lobes the clipping is visible at hard edges, so parity requires
+// quantizing exactly where PIL does.
+inline float quant8(float v) {
+    return std::min(255.0f, std::max(0.0f, std::nearbyint(v)));
 }
 
 // Resize one HWC uint8 frame to (oh, ow) float HWC via separable passes.
@@ -77,7 +99,7 @@ void resize_frame(const uint8_t* src, int h, int w, float* dst, int oh, int ow,
                 acc[2] += c * p[2];
             }
             float* o = trow + static_cast<size_t>(x) * 3;
-            o[0] = acc[0]; o[1] = acc[1]; o[2] = acc[2];
+            o[0] = quant8(acc[0]); o[1] = quant8(acc[1]); o[2] = quant8(acc[2]);
         }
     }
     // vertical pass: (h, ow, 3) -> (oh, ow, 3)
@@ -90,21 +112,21 @@ void resize_frame(const uint8_t* src, int h, int w, float* dst, int oh, int ow,
             const float* trow = tmp + static_cast<size_t>(t.lo + k) * ow * 3;
             for (int i = 0; i < ow * 3; ++i) orow[i] += c * trow[i];
         }
+        for (int i = 0; i < ow * 3; ++i) orow[i] = quant8(orow[i]);
     }
 }
 
 }  // namespace
 
-extern "C" {
+namespace {
 
-// Full torchvision chain for a batch of same-sized frames:
-// resize smaller edge -> resize_to (aspect kept), center-crop crop x crop,
+// Shared chain for a batch of same-sized frames: resize smaller edge ->
+// resize_to (aspect kept, `filter` kernel), center-crop crop x crop,
 // /255, normalize (mean/std per channel), emit NCHW float32.
-// src: (n, h, w, 3) uint8; out: (n, 3, crop, crop) float32.
-void imagenet_preprocess_batch(const uint8_t* src, int n, int h, int w,
-                               int resize_to, int crop,
-                               const float* mean, const float* stddev,
-                               float* out, int threads) {
+void preprocess_batch_impl(const uint8_t* src, int n, int h, int w,
+                           int resize_to, int crop, int filter,
+                           const float* mean, const float* stddev,
+                           float* out, int threads) {
     int oh, ow;
     if (h <= w) {
         oh = resize_to;
@@ -115,8 +137,8 @@ void imagenet_preprocess_batch(const uint8_t* src, int n, int h, int w,
     }
     std::vector<Tap> ytaps, xtaps;
     std::vector<float> ycoef, xcoef;
-    build_taps(h, oh, ytaps, ycoef);
-    build_taps(w, ow, xtaps, xcoef);
+    build_taps(h, oh, filter, ytaps, ycoef);
+    build_taps(w, ow, filter, xtaps, xcoef);
 
     // round-half-to-even, matching Python round() in the PIL chain
     const int top = static_cast<int>(std::nearbyint((oh - crop) / 2.0));
@@ -158,6 +180,28 @@ void imagenet_preprocess_batch(const uint8_t* src, int n, int h, int w,
         if (b < e) pool.emplace_back(work, b, e);
     }
     for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// torchvision chain (ResNet family): BILINEAR resize.
+void imagenet_preprocess_batch(const uint8_t* src, int n, int h, int w,
+                               int resize_to, int crop,
+                               const float* mean, const float* stddev,
+                               float* out, int threads) {
+    preprocess_batch_impl(src, n, h, w, resize_to, crop, /*filter=*/0, mean,
+                          stddev, out, threads);
+}
+
+// CLIP chain (pip `clip` preprocess): BICUBIC resize of the smaller edge
+// straight to the crop size, then the same crop/normalize.
+void clip_preprocess_batch(const uint8_t* src, int n, int h, int w, int size,
+                           const float* mean, const float* stddev, float* out,
+                           int threads) {
+    preprocess_batch_impl(src, n, h, w, size, size, /*filter=*/1, mean, stddev,
+                          out, threads);
 }
 
 }  // extern "C"
