@@ -74,6 +74,38 @@ class BaseExtractor:
         )
         return bool(files) and all(os.path.exists(f) for f in files)
 
+    # --- native host-preprocess decision (shared by the PIL-chain
+    # extractors: ResNet's bilinear chain, CLIP's bicubic chain) ----------
+    _use_native: Optional[bool] = None
+    _native_threads: int = 1
+
+    def _decide_native(self) -> None:
+        if self.config.host_preprocess == "native":
+            from video_features_tpu import native
+
+            self._use_native = native.available()
+            if not self._use_native:
+                print(
+                    f"native preprocess unavailable "
+                    f"({native.build_error()}); using PIL"
+                )
+            else:
+                # share host cores across concurrent device workers
+                from video_features_tpu.parallel.devices import resolve_devices
+
+                n_workers = max(len(resolve_devices(self.config)), 1)
+                self._native_threads = max((os.cpu_count() or 1) // n_workers, 1)
+        else:
+            self._use_native = False
+
+    def _native_decided(self) -> bool:
+        """One-shot backend decision (and unavailability warning); the
+        lock keeps it single-shot under concurrent decode workers."""
+        with self._build_lock:
+            if self._use_native is None:
+                self._decide_native()
+        return bool(self._use_native)
+
     # --- per-device model state -------------------------------------------
     def _build(self, device) -> Any:
         """Build jitted fns + device-resident params for ``device``."""

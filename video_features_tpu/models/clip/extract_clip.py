@@ -51,7 +51,6 @@ class ExtractCLIP(BaseExtractor):
             )
         self.model_cfg = CONFIGS[self.feature_type]
         self._host_params = None  # converted once, device_put per device
-        self._use_native = None  # decided (with one-time warning) on first use
 
     def _load_host_params(self):
         # called under _build_lock (warmup serializes _build calls)
@@ -113,34 +112,8 @@ class ExtractCLIP(BaseExtractor):
         """Sampled frames -> (T, 3, size, size). ``--host_preprocess
         native`` routes through the C++ BICUBIC chain (one call for the
         whole batch, ~1/255/pixel of PIL); 'pil' is the pip-``clip``-exact
-        path. Decided once under the lock (decode workers call this
-        concurrently)."""
-        import os
-
-        with self._build_lock:
-            if self._use_native is None:
-                if self.config.host_preprocess == "native":
-                    from video_features_tpu import native
-
-                    self._use_native = native.available()
-                    if not self._use_native:
-                        print(
-                            f"native preprocess unavailable "
-                            f"({native.build_error()}); using PIL"
-                        )
-                    else:
-                        # share host cores across concurrent device workers
-                        from video_features_tpu.parallel.devices import (
-                            resolve_devices,
-                        )
-
-                        n_workers = max(len(resolve_devices(self.config)), 1)
-                        self._native_threads = max(
-                            (os.cpu_count() or 1) // n_workers, 1
-                        )
-                else:
-                    self._use_native = False
-        if self._use_native:
+        path. Backend decided once (BaseExtractor._native_decided)."""
+        if self._native_decided():
             from video_features_tpu import native
 
             return native.clip_preprocess_batch(

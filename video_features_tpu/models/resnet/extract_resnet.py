@@ -41,8 +41,6 @@ class ExtractResNet(BaseExtractor):
         super().__init__(config, external_call)
         self.batch_size = max(int(self.config.batch_size or 1), 1)
         self._host_params = None
-        self._use_native = None  # decided (with one-time warning) on first batch
-        self._native_threads = 1
 
     def _load_host_params(self):
         if self._host_params is None:
@@ -84,37 +82,13 @@ class ExtractResNet(BaseExtractor):
         forward = jit_sharded_forward(forward, device, n_out=2)
         return {"params": params, "forward": forward, "device": device}
 
-    def _decide_native(self) -> None:
-        if self.config.host_preprocess == "native":
-            from video_features_tpu import native
-
-            self._use_native = native.available()
-            if not self._use_native:
-                print(
-                    f"native preprocess unavailable "
-                    f"({native.build_error()}); using PIL"
-                )
-            else:
-                # share host cores across concurrent device workers
-                from video_features_tpu.parallel.devices import resolve_devices
-
-                n_workers = max(len(resolve_devices(self.config)), 1)
-                self._native_threads = max((os.cpu_count() or 1) // n_workers, 1)
-        else:
-            self._use_native = False
-
     def _preprocess_batch(self, batch: List[np.ndarray]) -> np.ndarray:
         """raw uint8 HWC frames -> (n, 3, 224, 224) normalized float32.
 
         'native' routes through the threaded C++ chain (same-resolution
         frames batched in one call); 'pil' is the reference-exact path.
-        The backend decision (and any unavailability warning) happens once;
-        the lock keeps it single-shot now that decode worker threads call
-        this concurrently."""
-        with self._build_lock:
-            if self._use_native is None:
-                self._decide_native()
-        if self._use_native:
+        Backend decided once (BaseExtractor._native_decided)."""
+        if self._native_decided():
             from video_features_tpu import native
 
             return native.imagenet_preprocess_batch(
