@@ -10,6 +10,8 @@ configs, printed as ONE JSON line.
 - extra.pallas_corr_speedup_vs_xla: the PWC cost-volume microbench, Pallas
   VMEM-tiled kernel vs the XLA shifted-reduce formulation (TPU backends
   only; omitted on CPU where the Pallas kernel has no fast path).
+- extra.clip_bf16_vps (BENCH_BF16=1, opt-in — costs a second compile):
+  the CLIP config re-run under --dtype bfloat16.
 
 ``vs_baseline`` ratios divide by MEASURED numbers — the reference's own
 torch code timed on this host's CPU by scripts/measure_baseline.py
@@ -55,7 +57,7 @@ def _load_measured_baselines() -> dict:
     return MEASURED_BASELINES
 
 
-def bench_clip(n_videos: int, video: str, tmp: str) -> float:
+def bench_clip(n_videos: int, video: str, tmp: str, dtype: str = "float32") -> float:
     from video_features_tpu.config import ExtractionConfig
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
     from video_features_tpu.parallel.devices import resolve_devices
@@ -65,6 +67,7 @@ def bench_clip(n_videos: int, video: str, tmp: str) -> float:
         feature_type="CLIP-ViT-B/32",
         video_paths=[video] * n_videos,
         extract_method="uni_12",
+        dtype=dtype,
         tmp_path=os.path.join(tmp, "t"),
         output_path=os.path.join(tmp, "o"),
     )
@@ -165,6 +168,11 @@ def main() -> None:
             os.path.join(tmp, "i3d.mp4"), n_frames=140, width=256, height=256
         )
         clip_vps = bench_clip(n_videos, clip_video, tmp)
+        if os.environ.get("BENCH_BF16") == "1":
+            # --dtype bfloat16 variant (opt-in: costs a second XLA compile)
+            extra["clip_bf16_vps"] = round(
+                bench_clip(n_videos, clip_video, tmp, dtype="bfloat16"), 3
+            )
         if os.environ.get("BENCH_SKIP_I3D") != "1":
             extra["i3d_raft_vps"] = round(bench_i3d_raft(i3d_video, tmp), 3)
         extra.update(bench_pallas_corr())
