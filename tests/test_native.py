@@ -127,3 +127,88 @@ def test_extract_clip_native_preprocess(sample_video, tmp_path):
     # random-init features still track preprocess closely
     denom = np.linalg.norm(pil)
     assert np.linalg.norm(pil - nat) / max(denom, 1e-9) < 0.05
+
+
+# --- native decode loader (decoder.cpp) ------------------------------------
+
+decoder_skip = pytest.mark.skipif(
+    not native.decoder_available(),
+    reason=f"no native decoder: {native.decoder_build_error()}",
+)
+
+
+@decoder_skip
+def test_native_decoder_bit_identical_to_cv2(sample_video):
+    """Both backends decode through libavcodec; every frame, timestamp,
+    and probe field must match bit-for-bit."""
+    from video_features_tpu.io import video as vio
+
+    try:
+        vio.set_decoder("cv2")
+        ref_meta = vio.probe(sample_video)
+        ref = list(vio.stream_frames(sample_video))
+        ref_sampled, ref_fps, ref_ts = vio.extract_frames(sample_video, "uni_7")
+        vio.set_decoder("native")
+        nat_meta = vio.probe(sample_video)
+        nat = list(vio.stream_frames(sample_video))
+        nat_sampled, nat_fps, nat_ts = vio.extract_frames(sample_video, "uni_7")
+    finally:
+        vio.set_decoder("auto")
+
+    assert nat_meta == ref_meta
+    assert len(nat) == len(ref) and len(ref) > 0
+    for (fr_n, ts_n), (fr_c, ts_c) in zip(nat, ref):
+        np.testing.assert_array_equal(fr_n, fr_c)
+        assert ts_n == ts_c
+    assert nat_fps == ref_fps and nat_ts == ref_ts
+    for a, b in zip(nat_sampled, ref_sampled):
+        np.testing.assert_array_equal(a, b)
+
+
+@decoder_skip
+def test_native_decoder_fps_grid_matches_cv2(sample_video):
+    from video_features_tpu.io import video as vio
+
+    try:
+        vio.set_decoder("cv2")
+        ref = list(vio.stream_frames(sample_video, extraction_fps=7.0))
+        vio.set_decoder("native")
+        nat = list(vio.stream_frames(sample_video, extraction_fps=7.0))
+    finally:
+        vio.set_decoder("auto")
+    assert len(nat) == len(ref) > 0
+    for (fr_n, ts_n), (fr_c, ts_c) in zip(nat, ref):
+        np.testing.assert_array_equal(fr_n, fr_c)
+        assert ts_n == ts_c
+
+
+def test_decoder_knob_rejects_unknown():
+    from video_features_tpu.io import video as vio
+
+    with pytest.raises(ValueError):
+        vio.set_decoder("gstreamer")
+
+
+@decoder_skip
+def test_extract_resnet_with_native_decoder(sample_video, tmp_path):
+    """--decoder native end-to-end: identical features to --decoder cv2."""
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    def run(decoder):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="resnet18",
+            video_paths=[sample_video],
+            extraction_fps=3.0,
+            batch_size=4,
+            decoder=decoder,
+            cpu=True,
+        )
+        ex = ExtractResNet(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0])[0]
+
+    a = run("cv2")
+    b = run("native")
+    np.testing.assert_array_equal(a["resnet18"], b["resnet18"])
+    np.testing.assert_array_equal(a["timestamps_ms"], b["timestamps_ms"])

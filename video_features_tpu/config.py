@@ -99,6 +99,11 @@ class ExtractionConfig:
     # video computes (extract/base.py::_run_pipelined). 0 = fully serial
     # decode->compute, the reference's behavior.
     decode_workers: int = 2
+    # Decode backend (io/video.py): 'auto' (default) uses the native C++
+    # libav loader (native/decoder.cpp) when its library builds, falling
+    # back to cv2; 'cv2'/'native' force one. Both decode the same
+    # bitstream through libavcodec — frames are bit-identical.
+    decoder: str = "auto"
     # Host preprocessing backend for the PIL-chain extractors (the ResNet
     # family's bilinear chain and CLIP's bicubic chain): 'pil' reproduces
     # the reference bit-for-bit; 'native' uses the threaded C++ library
@@ -224,6 +229,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "absent/incomplete (features will be meaningless; "
                         "for tests/benchmarks)")
     p.add_argument("--decode_workers", type=int, default=2)
+    p.add_argument("--decoder", default="auto", choices=["auto", "cv2", "native"])
     p.add_argument("--host_preprocess", default="pil", choices=["pil", "native"])
     p.add_argument("--resume", action="store_true", default=False,
                    help="skip videos whose outputs already exist")

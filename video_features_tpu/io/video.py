@@ -1,8 +1,22 @@
 """Video decode + frame sampling on the host CPU.
 
 The reference uses four decode backends (mmcv, cv2 streaming, torchvision
-read_video, ffmpeg re-encode — SURVEY.md §1 L3). Here there is ONE:
-OpenCV's ``cv2.VideoCapture``, wrapped in
+read_video, ffmpeg re-encode — SURVEY.md §1 L3). Here there is ONE reader
+abstraction with two interchangeable backends:
+
+- ``cv2`` — OpenCV's ``VideoCapture`` (decodes BGR; flipped to RGB once
+  per retrieved frame),
+- ``native`` — the framework's own C++ decode loader
+  (native/decoder.cpp: libavformat/libavcodec/libswscale via ctypes),
+  which converts straight to RGB24 — no BGR round trip.
+
+``--decoder`` picks: 'auto' (default) uses the native loader when its
+library builds, 'cv2'/'native' force one. Both decode the same bitstream
+through libavcodec, so frames are bit-identical (tests/test_native.py).
+Samplers drop frames with ``grab()`` (decode, no color conversion) and
+pay ``retrieve()`` only for frames they keep.
+
+Wrapped in:
 
 - :func:`stream_frames` — a generator for frame-wise extractors (the
   cv2 streaming loop of ref models/resnet/extract_resnet.py:121-156),
@@ -13,9 +27,7 @@ OpenCV's ``cv2.VideoCapture``, wrapped in
 
 fps re-targeting is done in-process by nearest-timestamp frame selection
 instead of an ffmpeg re-encode subprocess (ref utils/utils.py:222-244);
-if an ffmpeg binary exists it can still be used via io.ffmpeg. Frames are
-returned RGB uint8 HWC (cv2 decodes BGR; we flip here, once — extractors
-needing BGR, i.e. PWC, flip back inside their preprocess).
+if an ffmpeg binary exists it can still be used via io.ffmpeg.
 
 Note: the reference computes ``mspf = 0.001 / fps`` (ref
 utils/utils.py:312) which is a unit bug; the correct milliseconds-per-frame
@@ -30,6 +42,97 @@ from typing import Iterator, List, Optional, Tuple
 import cv2
 import numpy as np
 
+_DECODER = "auto"  # 'auto' | 'cv2' | 'native'; set once from the config
+
+
+def set_decoder(name: str) -> None:
+    """Select the decode backend (called from config sanity_check /
+    BaseExtractor; 'native' raises at open time if the library can't
+    build, 'auto' silently falls back to cv2)."""
+    global _DECODER
+    if name not in ("auto", "cv2", "native"):
+        raise ValueError(f"unknown decoder backend: {name!r}")
+    _DECODER = name
+
+
+def _resolve(decoder: Optional[str]) -> str:
+    d = decoder or _DECODER
+    if d not in ("auto", "cv2", "native"):
+        raise ValueError(f"unknown decoder backend: {d!r}")
+    return d
+
+
+class _Reader:
+    """grab/retrieve reader over either backend, always yielding RGB.
+
+    ``grab()`` advances one frame without color conversion;
+    ``retrieve()`` converts the held frame. Dropping a frame costs decode
+    only — the sampler pattern both backends support.
+
+    ``decoder`` is per-reader (extractors pass their config's choice);
+    None uses the module default set by :func:`set_decoder`. 'auto' falls
+    back to cv2 PER FILE — the native loader refuses files it cannot
+    handle faithfully (unsupported codec, rotation metadata), not just
+    hosts where its library fails to build.
+    """
+
+    def __init__(self, path: str, decoder: Optional[str] = None) -> None:
+        d = _resolve(decoder)
+        self._nat = None
+        self._cap = None
+        if d != "cv2":
+            from video_features_tpu import native
+
+            if native.decoder_available():
+                try:
+                    self._nat = native.NativeVideoReader(path)
+                except IOError:
+                    if d == "native":
+                        raise
+            elif d == "native":
+                raise RuntimeError(
+                    f"--decoder native requested but the decode library is "
+                    f"unavailable: {native.decoder_build_error()}"
+                )
+        if self._nat is not None:
+            self.fps = self._nat.fps or 0.0
+            self.frame_count = int(self._nat.frame_count or 0)
+            self.width, self.height = self._nat.width, self._nat.height
+        else:
+            self._cap = cv2.VideoCapture(str(path))
+            if not self._cap.isOpened():
+                raise IOError(f"cannot open video: {path}")
+            self.fps = self._cap.get(cv2.CAP_PROP_FPS) or 0.0
+            self.frame_count = int(self._cap.get(cv2.CAP_PROP_FRAME_COUNT))
+            self.width = int(self._cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+            self.height = int(self._cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+
+    def grab(self) -> bool:
+        if self._nat is not None:
+            return self._nat.grab() >= 0
+        return self._cap.grab()
+
+    def retrieve(self) -> Optional[np.ndarray]:
+        if self._nat is not None:
+            return self._nat.retrieve()
+        ok, frame = self._cap.retrieve()
+        return cv2.cvtColor(frame, cv2.COLOR_BGR2RGB) if ok else None
+
+    def read(self) -> Optional[np.ndarray]:
+        return self.retrieve() if self.grab() else None
+
+    def close(self) -> None:
+        if self._nat is not None:
+            self._nat.close()
+        elif self._cap is not None:
+            self._cap.release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
 
 @dataclasses.dataclass(frozen=True)
 class VideoMeta:
@@ -43,21 +146,16 @@ class VideoMeta:
         return self.frame_count / self.fps if self.fps else 0.0
 
 
-def probe(path: str) -> VideoMeta:
-    cap = cv2.VideoCapture(str(path))
-    if not cap.isOpened():
-        raise IOError(f"cannot open video: {path}")
-    meta = VideoMeta(
-        fps=cap.get(cv2.CAP_PROP_FPS),
-        frame_count=int(cap.get(cv2.CAP_PROP_FRAME_COUNT)),
-        width=int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)),
-        height=int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)),
-    )
-    cap.release()
-    return meta
+def probe(path: str, decoder: Optional[str] = None) -> VideoMeta:
+    with _Reader(path, decoder) as r:
+        return VideoMeta(
+            fps=r.fps, frame_count=r.frame_count, width=r.width, height=r.height
+        )
 
 
-def read_frames_at_indices(path: str, indices) -> dict:
+def read_frames_at_indices(
+    path: str, indices, decoder: Optional[str] = None, allow_seek: bool = True
+) -> dict:
     """Decode returning {index: rgb_uint8_hwc} for the wanted frame
     indices; indices past the decodable end are simply absent.
 
@@ -77,9 +175,11 @@ def read_frames_at_indices(path: str, indices) -> dict:
     # frame decodes (GOP re-decode), so random access pays off only below
     # ~1-in-16 density (uni_12 over a 2-minute clip stays sequential; a
     # low --extraction_fps over a long video seeks)
-    if len(need) * 16 < span:
-        # sparse: random-access each wanted frame. Same semantics (and the
-        # same codec-dependent accuracy caveats) as the reference's mmcv
+    if allow_seek and len(need) * 16 < span:
+        # sparse: random-access each wanted frame (cv2-only: pts->index
+        # mapping for av_seek_frame is container-dependent, so the native
+        # loader stays sequential). Same semantics (and the same
+        # codec-dependent accuracy caveats) as the reference's mmcv
         # VideoReader.get_frame, which also seeks via CAP_PROP_POS_FRAMES.
         # Guard: if the backend doesn't honor a seek (POS_FRAMES readback
         # mismatch), fall through to the always-exact sequential decode
@@ -103,80 +203,73 @@ def read_frames_at_indices(path: str, indices) -> dict:
 
     got = {}
     wanted = set(need)
-    cap = cv2.VideoCapture(str(path))
-    try:
-        i = 0
-        while i < span:
-            ok, frame = cap.read()
-            if not ok:
+    with _Reader(path, decoder) as r:
+        for i in range(span):
+            if not r.grab():
                 break
             if i in wanted:
-                got[i] = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
-            i += 1
-    finally:
-        cap.release()
+                frame = r.retrieve()
+                if frame is not None:
+                    got[i] = frame
     return got
 
 
 def stream_frames(
     path: str,
     extraction_fps: Optional[float] = None,
+    decoder: Optional[str] = None,
 ) -> Iterator[Tuple[np.ndarray, float]]:
     """Yield (rgb_uint8_hwc, timestamp_ms) frames sequentially.
 
     With ``extraction_fps`` set, frames are selected on the target fps grid
-    while still decoding sequentially (no random seeks — mp4 seeking in
-    cv2 is keyframe-inaccurate).
+    while still decoding sequentially (no random seeks — mp4 seeking is
+    keyframe-inaccurate); skipped grid frames are grabbed, never converted.
     """
-    cap = cv2.VideoCapture(str(path))
-    if not cap.isOpened():
-        raise IOError(f"cannot open video: {path}")
-    src_fps = cap.get(cv2.CAP_PROP_FPS) or 25.0
-    frame_count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
-
-    try:
+    with _Reader(path, decoder) as r:
+        src_fps = r.fps or 25.0
         if extraction_fps is None:
             i = 0
             while True:
-                ok, frame = cap.read()
-                if not ok:
+                frame = r.read()
+                if frame is None:
                     break
-                yield cv2.cvtColor(frame, cv2.COLOR_BGR2RGB), i * 1000.0 / src_fps
+                yield frame, i * 1000.0 / src_fps
                 i += 1
         else:
-            # Select source frames nearest the target fps grid while decoding
-            # sequentially. Works without a (reliable) frame count: output
-            # frame k maps to source index round(k * src_fps / dst_fps);
-            # duplicates when upsampling, drops when downsampling.
+            # Select source frames nearest the target fps grid while
+            # decoding sequentially. Works without a (reliable) frame
+            # count: output frame k maps to source index
+            # round(k * src_fps / dst_fps); duplicates when upsampling,
+            # drops when downsampling.
             out_k = 0
             src_i = -1
             frame = None
             while True:
                 target = int(round(out_k * src_fps / extraction_fps))
+                fresh = False
                 while src_i < target:
-                    ok, nxt = cap.read()
-                    if not ok:
+                    if not r.grab():
                         return
-                    frame = nxt
+                    fresh = True
                     src_i += 1
-                yield (
-                    cv2.cvtColor(frame, cv2.COLOR_BGR2RGB),
-                    out_k * 1000.0 / extraction_fps,
-                )
+                if fresh:
+                    frame = r.retrieve()
+                    if frame is None:
+                        return
+                yield frame, out_k * 1000.0 / extraction_fps
                 out_k += 1
-    finally:
-        cap.release()
 
 
 def read_all_frames(
     path: str,
     extraction_fps: Optional[float] = None,
+    decoder: Optional[str] = None,
 ) -> Tuple[List[np.ndarray], float, List[float]]:
     """Whole-clip decode -> (rgb frames, effective fps, timestamps_ms)."""
-    meta = probe(path)
+    meta = probe(path, decoder)
     fps = extraction_fps or meta.fps or 25.0
     frames, stamps = [], []
-    for frame, ts in stream_frames(path, extraction_fps):
+    for frame, ts in stream_frames(path, extraction_fps, decoder):
         frames.append(frame)
         stamps.append(ts)
     return frames, fps, stamps
@@ -185,6 +278,7 @@ def read_all_frames(
 def extract_frames(
     path: str,
     method: str,
+    decoder: Optional[str] = None,
 ) -> Tuple[List[np.ndarray], float, List[float]]:
     """``fix_<fps>`` / ``uni_<N>`` samplers, mirroring ref utils/utils.py:297-333.
 
@@ -193,7 +287,7 @@ def extract_frames(
     decode-fragile). Returns (rgb frames, source fps, timestamps_ms).
     """
     ext, *params = method.split("_")
-    meta = probe(path)
+    meta = probe(path, decoder)
     fps, frame_cnt = meta.fps or 25.0, meta.frame_count
     if frame_cnt < 3:
         raise IOError(f"video too short for sampling ({frame_cnt} frames): {path}")
@@ -208,21 +302,12 @@ def extract_frames(
     samples_num = max(samples_num, 1)
     samples_ix = np.linspace(1, frame_cnt - 2, samples_num).astype(int)
 
-    wanted = set(samples_ix.tolist())
-    got = {}
-    cap = cv2.VideoCapture(str(path))
-    try:
-        i = 0
-        last = max(wanted)
-        while i <= last:
-            ok, frame = cap.read()
-            if not ok:
-                break
-            if i in wanted:
-                got[i] = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
-            i += 1
-    finally:
-        cap.release()
+    # allow_seek=False: the reference's samplers decode sequentially up to
+    # max(index) (ref utils/utils.py:297-333) — always frame-exact. Seek
+    # accuracy can't be verified deeply enough (open-GOP / B-frame
+    # reordering passes the POS_FRAMES readback guard) to risk the
+    # sampled-feature contract on it.
+    got = read_frames_at_indices(path, samples_ix, decoder, allow_seek=False)
     if not got:
         raise IOError(f"no frames decoded from {path}")
     # duplicate indices in linspace (short videos) resolve to the same frame

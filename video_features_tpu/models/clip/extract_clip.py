@@ -128,7 +128,7 @@ class ExtractCLIP(BaseExtractor):
     def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
         frames, fps, timestamps_ms = extract_frames(
-            video_path, self.config.extract_method
+            video_path, self.config.extract_method, self.config.decoder
         )
         batch = self._preprocess_frames(frames)  # (T, 3, H, W)
         T = batch.shape[0]

@@ -117,13 +117,16 @@ class PairwiseFlowExtractor(BaseExtractor):
 
     def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
         video_path = video_path_of(path_entry)
-        fps = self.config.extraction_fps or probe(video_path).fps or 25.0
+        fps = (self.config.extraction_fps
+               or probe(video_path, self.config.decoder).fps or 25.0)
 
         flows: List[np.ndarray] = []
         timestamps_ms: List[float] = []
         batch: List[np.ndarray] = []
         padder = None
-        for frame, ts in stream_frames(video_path, self.config.extraction_fps):
+        for frame, ts in stream_frames(
+            video_path, self.config.extraction_fps, self.config.decoder
+        ):
             timestamps_ms.append(ts)
             frame = self._preprocess(frame)
             if padder is None:

@@ -244,7 +244,7 @@ class ExtractI3D(BaseExtractor):
         """The reference's I3D-specific sampling grid
         (ref extract_i3d.py:239-259): fps-linspace / short-video
         upsample-to-65 / all frames. Returns (frames, fps, timestamps_ms)."""
-        meta = probe(video_path)
+        meta = probe(video_path, self.config.decoder)
         fps = meta.fps or 25.0
         frame_cnt = meta.frame_count
         mspf = 1000.0 / fps
@@ -257,7 +257,7 @@ class ExtractI3D(BaseExtractor):
         else:
             samples_ix = np.arange(frame_cnt)
 
-        wanted = read_frames_at_indices(video_path, samples_ix)
+        wanted = read_frames_at_indices(video_path, samples_ix, self.config.decoder)
         # undecodable sampled indices are dropped, exactly like the
         # reference's `if i is not None` filter (ref extract_i3d.py:245-257)
         frames = [wanted[i] for i in samples_ix if i in wanted]

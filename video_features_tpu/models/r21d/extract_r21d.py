@@ -123,7 +123,9 @@ class ExtractR21D(BaseExtractor):
     # it would after float conversion)
     def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
-        frames, _, _ = read_all_frames(video_path, self.config.extraction_fps)
+        frames, _, _ = read_all_frames(
+            video_path, self.config.extraction_fps, self.config.decoder
+        )
         if not frames:
             raise IOError(f"no frames decoded from {video_path}")
         clip = np.stack(frames)  # (T, H, W, 3) uint8, stays on host

@@ -122,7 +122,7 @@ class ExtractResNet(BaseExtractor):
             counts.append(n)
 
         n_frames = 0
-        for frame, ts in stream_frames(video_path, fps):
+        for frame, ts in stream_frames(video_path, fps, self.config.decoder):
             n_frames += 1
             if n_frames > self.PIPELINE_MAX_FRAMES:
                 return ("stream", video_path)  # too big to prefetch whole
@@ -137,7 +137,7 @@ class ExtractResNet(BaseExtractor):
             raise IOError(f"no frames decoded from {video_path}")
         from video_features_tpu.io.video import probe
 
-        actual_fps = fps or probe(video_path).fps or 25.0
+        actual_fps = fps or probe(video_path, self.config.decoder).fps or 25.0
         return batches, counts, actual_fps, timestamps_ms
 
     def _extract_streaming(self, state, video_path) -> Dict[str, np.ndarray]:
@@ -163,7 +163,7 @@ class ExtractResNet(BaseExtractor):
             if self.config.show_pred:
                 show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
 
-        for frame, ts in stream_frames(video_path, fps):
+        for frame, ts in stream_frames(video_path, fps, self.config.decoder):
             batch.append(frame)
             timestamps_ms.append(ts)
             if len(batch) == self.batch_size:
@@ -175,7 +175,7 @@ class ExtractResNet(BaseExtractor):
             raise IOError(f"no frames decoded from {video_path}")
         from video_features_tpu.io.video import probe
 
-        actual_fps = fps or probe(video_path).fps or 25.0
+        actual_fps = fps or probe(video_path, self.config.decoder).fps or 25.0
         return {
             self.feature_type: np.concatenate(feats_out, axis=0),
             "fps": np.array(actual_fps),

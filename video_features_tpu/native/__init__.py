@@ -26,21 +26,21 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _compile() -> Optional[str]:
+def _compile_lib(src: str, out_path: str, extra: Sequence[str] = ()) -> Optional[str]:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     # compile to a per-pid temp and rename: concurrent processes may race
     # on the shared output path, and dlopen of a half-written .so would
     # poison this process's native path for the whole run
-    tmp_out = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    tmp_out = f"{out_path}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", tmp_out,
+        src, "-o", tmp_out, *extra,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         if proc.returncode != 0:
             return proc.stderr[-2000:]
-        os.replace(tmp_out, _LIB_PATH)
+        os.replace(tmp_out, out_path)
     except (OSError, subprocess.TimeoutExpired) as e:
         return f"{type(e).__name__}: {e}"
     finally:
@@ -50,6 +50,10 @@ def _compile() -> Optional[str]:
             except OSError:
                 pass
     return None
+
+
+def _compile() -> Optional[str]:
+    return _compile_lib(_SRC, _LIB_PATH)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -165,3 +169,127 @@ def clip_preprocess_batch(
         threads,
     )
     return out
+
+
+# --- native video decode loader (decoder.cpp) ------------------------------
+#
+# Separate .so with its own graceful availability: it links libavformat/
+# libavcodec/libswscale, which may be absent on some hosts even when the
+# C++ toolchain (and so the preprocess library) is fine.
+
+_DEC_SRC = os.path.join(_DIR, "decoder.cpp")
+_DEC_LIB_PATH = os.path.join(_BUILD_DIR, "libvfdecode.so")
+_dec_lib: Optional[ctypes.CDLL] = None
+_dec_build_error: Optional[str] = None
+
+
+def _load_decoder() -> Optional[ctypes.CDLL]:
+    global _dec_lib, _dec_build_error
+    with _lock:
+        if _dec_lib is not None or _dec_build_error is not None:
+            return _dec_lib
+        if not os.path.exists(_DEC_LIB_PATH) or (
+            os.path.getmtime(_DEC_LIB_PATH) < os.path.getmtime(_DEC_SRC)
+        ):
+            err = _compile_lib(
+                _DEC_SRC, _DEC_LIB_PATH,
+                extra=["-lavformat", "-lavcodec", "-lswscale", "-lavutil"],
+            )
+            if err is not None:
+                _dec_build_error = err
+                return None
+        try:
+            lib = ctypes.CDLL(_DEC_LIB_PATH)
+        except OSError as e:
+            _dec_build_error = str(e)
+            return None
+        lib.vfdec_open.argtypes = [ctypes.c_char_p]
+        lib.vfdec_open.restype = ctypes.c_void_p
+        lib.vfdec_probe.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.vfdec_probe.restype = None
+        lib.vfdec_grab.argtypes = [ctypes.c_void_p]
+        lib.vfdec_grab.restype = ctypes.c_int64
+        lib.vfdec_retrieve.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)
+        ]
+        lib.vfdec_retrieve.restype = ctypes.c_int
+        lib.vfdec_close.argtypes = [ctypes.c_void_p]
+        lib.vfdec_close.restype = None
+        _dec_lib = lib
+        return _dec_lib
+
+
+def decoder_available() -> bool:
+    return _load_decoder() is not None
+
+
+def decoder_build_error() -> Optional[str]:
+    _load_decoder()
+    return _dec_build_error
+
+
+class NativeVideoReader:
+    """Sequential RGB frame reader over the C decode loader.
+
+    ``grab()`` advances one frame WITHOUT color conversion (returns the
+    new frame index or -1 at end); ``retrieve()`` converts the held frame
+    to an (H, W, 3) RGB uint8 array. Samplers that skip frames pay decode
+    cost only — no swscale pass — for the frames they drop, which cv2's
+    ``read()`` cannot avoid."""
+
+    def __init__(self, path: str) -> None:
+        lib = _load_decoder()
+        if lib is None:
+            raise RuntimeError(f"native decoder unavailable: {_dec_build_error}")
+        self._lib = lib
+        self._h = lib.vfdec_open(os.fsencode(path))
+        if not self._h:
+            raise IOError(f"native decoder could not open {path}")
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        fps = ctypes.c_double()
+        n = ctypes.c_int64()
+        lib.vfdec_probe(self._h, ctypes.byref(w), ctypes.byref(h),
+                        ctypes.byref(fps), ctypes.byref(n))
+        self.width, self.height = w.value, h.value
+        self.fps = fps.value or None
+        self.frame_count = n.value or None  # container estimate; may be None
+
+    def grab(self) -> int:
+        return int(self._lib.vfdec_grab(self._h))
+
+    def retrieve(self) -> np.ndarray:
+        out = np.empty((self.height, self.width, 3), np.uint8)
+        r = self._lib.vfdec_retrieve(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        )
+        if r != 0:
+            raise IOError("native decoder retrieve failed")
+        return out
+
+    def read(self) -> Optional[np.ndarray]:
+        """cv2-style: next frame as RGB, or None at end of stream."""
+        if self.grab() < 0:
+            return None
+        return self.retrieve()
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.vfdec_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort; close() is the real contract
+        try:
+            self.close()
+        except Exception:
+            pass
