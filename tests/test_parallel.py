@@ -236,20 +236,44 @@ def test_mesh_cli_matches_queue_outputs(sample_video, tmp_path):
 
 
 def test_mesh_rejects_unsupported_feature_type(sample_video, tmp_path):
-    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.parallel.scheduler import mesh_feature_extraction
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="i3d",
+        video_paths=[sample_video],
+        tmp_path=str(tmp_path / "t"),
+        output_path=str(tmp_path / "o"),
+    )
+    ex = ExtractI3D(cfg)
+    ex.progress.disable = True
+    with pytest.raises(ValueError, match="sharding mesh"):
+        mesh_feature_extraction(ex, jax.devices())
+
+
+def test_mesh_raft_sequence_parallel_matches_single_device(sample_video, tmp_path):
+    """Flow extractors shard the FRAME axis over 'data' (the models'
+    consecutive-pair views become GSPMD halo exchanges). Features must be
+    byte-identical to the single-device run."""
+    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
 
     cfg = ExtractionConfig(
         allow_random_init=True,
         feature_type="raft",
         video_paths=[sample_video],
+        batch_size=8,
+        side_size=128,
         tmp_path=str(tmp_path / "t"),
         output_path=str(tmp_path / "o"),
     )
-    ex = ExtractRAFT(cfg)
+    ex = ExtractRAFT(cfg, external_call=True)
     ex.progress.disable = True
-    with pytest.raises(ValueError, match="sharding mesh"):
-        mesh_feature_extraction(ex, jax.devices())
+    single = ex([0], device=jax.devices()[0])
+    mesh = make_mesh(jax.devices(), model=1)
+    sharded = ex([0], device=mesh)
+    np.testing.assert_array_equal(single[0]["raft"], sharded[0]["raft"])
+    assert single[0]["raft"].shape[1] == 2
 
 
 def test_mesh_model_axis_rejected_for_dp_only_models(sample_video, tmp_path):
