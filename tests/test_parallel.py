@@ -235,6 +235,40 @@ def test_mesh_cli_matches_queue_outputs(sample_video, tmp_path):
     np.testing.assert_allclose(mesh_tp, queue, atol=2e-4)
 
 
+def test_mesh_context_cli_matches_queue_outputs(sample_video, tmp_path):
+    """--mesh_context through the real CLI: the ViT's 50-token patch axis
+    shards over the mesh 'data' axis and attention runs as a KV ring
+    (parallel/ring_attention.py), composed with TP head sharding
+    (--mesh_model 2). Features must match queue mode to reduction-order
+    tolerance."""
+    queue = _run_main(sample_video, tmp_path / "q", ["--sharding", "queue"])
+    ctx = _run_main(
+        sample_video,
+        tmp_path / "cp",
+        ["--sharding", "mesh", "--mesh_model", "2", "--mesh_context"],
+    )
+    np.testing.assert_allclose(ctx, queue, atol=2e-4)
+
+
+def test_mesh_context_rejects_non_transformer(sample_video, tmp_path):
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+    from video_features_tpu.parallel.scheduler import mesh_feature_extraction
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="r21d",
+        video_paths=[sample_video],
+        tmp_path=str(tmp_path / "t"),
+        output_path=str(tmp_path / "o"),
+        sharding="mesh",
+        mesh_context=True,
+    )
+    ex = ExtractR21D(cfg)
+    ex.progress.disable = True
+    with pytest.raises(ValueError, match="mesh_context"):
+        mesh_feature_extraction(ex, jax.devices())
+
+
 def test_mesh_rejects_unsupported_feature_type(sample_video, tmp_path):
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.parallel.scheduler import mesh_feature_extraction

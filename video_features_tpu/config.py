@@ -131,6 +131,12 @@ class ExtractionConfig:
     sharding: str = "queue"
     # 'model' (tensor-parallel) axis size of the mesh; 'data' gets the rest.
     mesh_model: int = 1
+    # Context parallelism (--sharding mesh only): shard the transformer's
+    # token axis over the mesh 'data' axis and run ring attention — KV
+    # shards rotate chip-to-chip over ICI (parallel/ring_attention.py) —
+    # instead of sharding the frame batch. The long-sequence regime:
+    # activation memory per chip is O(L/n). CLIP only (the transformer).
+    mesh_context: bool = False
 
     def __post_init__(self) -> None:
         if self.streams is not None and not isinstance(self.streams, (list, tuple)):
@@ -183,6 +189,8 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise ValueError(f"unknown sharding strategy: {cfg.sharding}")
     if cfg.mesh_model < 1:
         raise ValueError(f"mesh_model must be >= 1, got {cfg.mesh_model}")
+    if cfg.mesh_context and cfg.sharding != "mesh":
+        raise ValueError("--mesh_context requires --sharding mesh")
     return cfg
 
 
@@ -241,6 +249,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(data, model) mesh of all selected devices")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis size of the --sharding mesh")
+    p.add_argument("--mesh_context", action="store_true",
+                   help="context parallelism under --sharding mesh: shard "
+                        "the transformer token axis over the mesh and run "
+                        "ring attention (KV shards rotate over ICI); "
+                        "composes with --mesh_model head sharding")
     return p
 
 

@@ -40,6 +40,10 @@ class BaseExtractor:
     # True when the extractor additionally defines tensor-parallel param
     # specs, i.e. --mesh_model > 1 shards weights instead of replicating.
     mesh_tp_capable: bool = False
+    # True when the extractor can run --mesh_context: its model has a
+    # transformer token axis to shard, and its _build injects ring
+    # attention (parallel/ring_attention.py) when the flag is set.
+    mesh_context_capable: bool = False
 
     def __init__(self, config, external_call: bool = False) -> None:
         self.config = as_config(config)

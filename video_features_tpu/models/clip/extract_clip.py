@@ -42,6 +42,7 @@ class ExtractCLIP(BaseExtractor):
     # data parallelism over the sampled-frame batch (parallel/sharding.py)
     mesh_capable = True
     mesh_tp_capable = True  # clip_vit_param_specs shard the 'model' axis
+    mesh_context_capable = True  # ring attention over the patch-token axis
 
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)
@@ -82,7 +83,16 @@ class ExtractCLIP(BaseExtractor):
         )
 
         dt = compute_dtype(self.config)
-        model = VisionTransformer(self.model_cfg, dtype=dt)
+        context = is_mesh(device) and self.config.mesh_context
+        if context:
+            from video_features_tpu.parallel.ring_attention import (
+                make_context_parallel_core,
+            )
+
+            attn_core = make_context_parallel_core(device)
+        else:
+            attn_core = None
+        model = VisionTransformer(self.model_cfg, dtype=dt, attn_core=attn_core)
         params = self._load_host_params()
         if dt != jnp.float32:
             # final projection stays fp32 (the 512-d embedding contract)
@@ -90,9 +100,17 @@ class ExtractCLIP(BaseExtractor):
 
         if is_mesh(device):
             # one GSPMD-sharded executable: TP over attention/MLP weights,
-            # DP over the frame batch — the dryrun_multichip code path
+            # plus either DP over the frame batch (default) or context
+            # parallelism over the patch-token axis (--mesh_context: ring
+            # attention, KV shards rotating over ICI; the batch replicates
+            # and the token axis shards inside the model)
+            from jax.sharding import PartitionSpec as P
+
             params = place_params(params, device, clip_vit_param_specs)
-            encode_image = build_sharded_apply(model, device)
+            spec = P() if context else P("data")
+            encode_image = build_sharded_apply(
+                model, device, batch_spec=spec, out_spec=spec
+            )
         else:
             params = jax.device_put(params, device)
 
@@ -100,7 +118,8 @@ class ExtractCLIP(BaseExtractor):
             def encode_image(p, x):
                 return model.apply({"params": p}, x)
 
-        return {"params": params, "encode_image": encode_image, "device": device}
+        return {"params": params, "encode_image": encode_image,
+                "device": device, "pad_data": not context}
 
     def _preprocess(self, frame: np.ndarray) -> np.ndarray:
         size = self.model_cfg.image_size
@@ -140,8 +159,13 @@ class ExtractCLIP(BaseExtractor):
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
         padded, T, fps, timestamps_ms = payload
-        padded = pad_batch_for(state["device"], padded)  # mesh: /data-divisible
-        x = place_batch(padded, state["device"])
+        if state.get("pad_data", True):  # mesh DP: /data-divisible batch
+            padded = pad_batch_for(state["device"], padded)
+            x = place_batch(padded, state["device"])
+        else:  # mesh_context: batch replicates, tokens shard in-model
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            x = jax.device_put(padded, NamedSharding(state["device"], P()))
         feats = np.asarray(state["encode_image"](state["params"], x))[:T]
         return {
             self.feature_type: feats,

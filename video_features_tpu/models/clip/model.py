@@ -17,10 +17,13 @@ checkpoint.
 from __future__ import annotations
 
 import dataclasses
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from video_features_tpu.ops.attention import attention as fused_attention
 
 HIGHEST = jax.lax.Precision.HIGHEST
 
@@ -56,9 +59,18 @@ def quick_gelu(x: jnp.ndarray) -> jnp.ndarray:
 
 
 class Attention(nn.Module):
+    """Multi-head self-attention with a swappable core.
+
+    ``attn_core(q, k, v) -> out`` on (N, H, L, hd) tensors replaces the
+    fused full-score-matrix core (ops/attention.py semantics). The mesh
+    ``--mesh_context`` path injects ring attention here
+    (parallel/ring_attention.py::make_context_parallel_core): the token
+    axis shards over the mesh and KV shards rotate over ICI."""
+
     width: int
     heads: int
     dtype: jnp.dtype = jnp.float32
+    attn_core: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (N, L, D)
@@ -70,9 +82,8 @@ class Attention(nn.Module):
         q = q.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(N, L, self.heads, hd).transpose(0, 2, 1, 3)
-        attn = jnp.einsum("nhqd,nhkd->nhqk", q, k, precision=HIGHEST) * (hd ** -0.5)
-        attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(x.dtype)
-        out = jnp.einsum("nhqk,nhkd->nhqd", attn, v, precision=HIGHEST)
+        core = self.attn_core if self.attn_core is not None else fused_attention
+        out = core(q, k, v)
         out = out.transpose(0, 2, 1, 3).reshape(N, L, D)
         return nn.Dense(self.width, dtype=self.dtype, name="out_proj")(out)
 
@@ -83,6 +94,7 @@ class Block(nn.Module):
     quick_gelu: bool
     eps: float
     dtype: jnp.dtype = jnp.float32
+    attn_core: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -91,7 +103,8 @@ class Block(nn.Module):
         act = quick_gelu if self.quick_gelu else nn.gelu
         y = nn.LayerNorm(epsilon=self.eps, dtype=jnp.float32, name="ln_1")(x)
         y = y.astype(self.dtype)
-        x = x + Attention(self.width, self.heads, self.dtype, name="attn")(y)
+        x = x + Attention(self.width, self.heads, self.dtype,
+                          self.attn_core, name="attn")(y)
         y = nn.LayerNorm(epsilon=self.eps, dtype=jnp.float32, name="ln_2")(x)
         y = y.astype(self.dtype)
         y = nn.Dense(self.width * 4, dtype=self.dtype, name="c_fc")(y)
@@ -109,6 +122,10 @@ class VisionTransformer(nn.Module):
 
     cfg: CLIPVisionConfig
     dtype: jnp.dtype = jnp.float32
+    # optional swapped attention core, e.g. context-parallel ring
+    # attention under --sharding mesh --mesh_context (parity: the core is
+    # mathematically exact, so converted OpenAI weights are unaffected)
+    attn_core: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -140,7 +157,7 @@ class VisionTransformer(nn.Module):
         x = x.astype(self.dtype)
         for i in range(c.layers):
             x = Block(c.width, c.heads, c.quick_gelu, c.eps, self.dtype,
-                      name=f"resblock_{i}")(x)
+                      self.attn_core, name=f"resblock_{i}")(x)
         x = nn.LayerNorm(epsilon=c.eps, dtype=jnp.float32, name="ln_post")(x[:, 0])
         proj = self.param(
             "proj", nn.initializers.normal(c.width ** -0.5), (c.width, c.embed_dim)

@@ -1,0 +1,143 @@
+"""Attention cores: fused, blockwise (online-softmax), and masked.
+
+The reference's only attention is inside OpenAI's pip ``clip`` package
+(torch ``nn.MultiheadAttention``, consumed at ref
+models/CLIP/extract_clip.py:46-63); it materializes the full (L, L) score
+matrix. These cores are the TPU-native replacements and the building
+blocks for the framework's long-context story:
+
+- ``attention``            — the fused two-einsum core (softmax fp32).
+  Right answer for short sequences (ViT's 50/197 patch tokens): XLA fuses
+  it and the whole score matrix fits in VMEM.
+- ``blockwise_attention``  — FlashAttention-style ``lax.scan`` over KV
+  blocks with a running (max, sum, acc) accumulator. O(L_q * B) live
+  scores instead of O(L_q * L_kv): the long-sequence core, and the exact
+  per-step update ring attention replays across chips
+  (parallel/ring_attention.py).
+
+Both take (N, H, L, d) tensors, return (N, H, L_q, d), accumulate softmax
+statistics in fp32 regardless of input dtype, and accept ``kv_len`` to
+mask right-padding on the KV axis (needed whenever a token axis is padded
+up to a mesh-divisible length).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+HIGHEST = lax.Precision.HIGHEST
+
+# Scores at masked KV positions are set to this (not -inf: an all-masked
+# block would give exp(-inf - (-inf)) = nan in the online update).
+_MASK_VALUE = -1e30
+
+
+def _scores(q: jnp.ndarray, k: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """(N,H,Lq,d) x (N,H,Lk,d) -> fp32 (N,H,Lq,Lk) scaled scores."""
+    s = jnp.einsum("nhqd,nhkd->nhqk", q, k, precision=HIGHEST)
+    return s.astype(jnp.float32) * scale
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_len: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Fused core: full score matrix, fp32 softmax, output in q.dtype."""
+    scale = q.shape[-1] ** -0.5
+    s = _scores(q, k, scale)
+    if kv_len is not None:
+        mask = jnp.arange(k.shape[2]) < kv_len
+        s = jnp.where(mask[None, None, None, :], s, _MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("nhqk,nhkd->nhqd", p, v, precision=HIGHEST)
+
+
+def online_softmax_step(
+    q: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    m: jnp.ndarray,
+    l: jnp.ndarray,
+    acc: jnp.ndarray,
+    scale: float,
+    kv_mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One numerically-stable softmax accumulation step over a KV block.
+
+    Carries (all fp32): ``m`` (N,H,Lq) running max, ``l`` (N,H,Lq) running
+    sum of exp, ``acc`` (N,H,Lq,d) running weighted-value sum. ``kv_mask``
+    is (..., Lk) True at valid KV positions. This is the exact update both
+    ``blockwise_attention`` (over local blocks) and ring attention (over
+    chips) iterate.
+    """
+    s = _scores(q, k_blk, scale)  # (N,H,Lq,Lk)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask, s, _MASK_VALUE)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum(
+        "nhqk,nhkd->nhqd", p.astype(v_blk.dtype), v_blk, precision=HIGHEST
+    ).astype(jnp.float32)
+    acc_new = acc * corr[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def _finalize(m, l, acc, dtype):
+    # l == 0 only if every KV position was masked; emit zeros, not nan.
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(dtype)
+
+
+def init_carry(q: jnp.ndarray):
+    """Fresh (m, l, acc) for the online-softmax recurrence."""
+    N, H, Lq, d = q.shape
+    m = jnp.full((N, H, Lq), _MASK_VALUE, dtype=jnp.float32)
+    l = jnp.zeros((N, H, Lq), dtype=jnp.float32)
+    acc = jnp.zeros((N, H, Lq, d), dtype=jnp.float32)
+    return m, l, acc
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_size: int = 512,
+    kv_len: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """FlashAttention-style scan over KV blocks; exact vs ``attention``.
+
+    KV is right-padded to a multiple of ``block_size`` (padding is masked,
+    composing with the caller's own ``kv_len`` mask), then scanned with
+    ``online_softmax_step``. Peak live score memory is O(Lq * block_size).
+    """
+    N, H, Lk, d = k.shape
+    scale = q.shape[-1] ** -0.5
+    nb = -(-Lk // block_size)
+    pad = nb * block_size - Lk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    limit = jnp.asarray(Lk if kv_len is None else kv_len)
+    kb = k.reshape(N, H, nb, block_size, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(N, H, nb, block_size, d).transpose(2, 0, 1, 3, 4)
+    offs = jnp.arange(nb) * block_size
+
+    def step(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, off = blk
+        mask = (off + jnp.arange(block_size)) < limit
+        m, l, acc = online_softmax_step(
+            q, k_blk, v_blk, m, l, acc, scale, kv_mask=mask[None, None, None, :]
+        )
+        return (m, l, acc), None
+
+    (m, l, acc), _ = lax.scan(step, init_carry(q), (kb, vb, offs))
+    return _finalize(m, l, acc, q.dtype)

@@ -1,0 +1,164 @@
+"""Ring attention: exact context parallelism over a mesh axis.
+
+The reference caps sequence length at whatever one GPU's memory holds —
+its CLIP path materializes full (L, L) score matrices per head inside
+torch MultiheadAttention, and its only parallelism is video-list
+scatter (ref main.py:49-55). This module is the TPU-native long-context
+story the reference has no analog of: shard the token axis over a mesh
+axis, keep every chip's K/V shard resident, and rotate K/V shards around
+the ICI ring with ``lax.ppermute`` while each chip folds them into the
+FlashAttention online-softmax accumulator (ops/attention.py). After
+``axis_size`` hops every Q shard has seen every KV shard: the result is
+*bit-identical math* to full attention, with O(L/n) activation memory per
+chip and compute/communication overlapped by XLA's async collective
+scheduling.
+
+Layout contract: (N, H, L, d) tensors with L sharded over ``axis_name``;
+right-padding on L (to make it mesh-divisible) is masked via ``kv_len``
+— global token positions >= kv_len contribute nothing, and padded query
+rows compute garbage the caller slices off (parallel/sharding.py
+``pad_batch_for`` convention).
+
+``ring_attention`` is the per-shard collective (call under ``shard_map``);
+``ring_attention_sharded`` wraps it for use inside a GSPMD-jitted model,
+which is how the CLIP ViT runs it in ``--sharding mesh --mesh_context``
+mode (models/clip/model.py::Attention).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import _finalize, init_carry, online_softmax_step
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    kv_len: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-shard ring attention; must run under shard_map/pmap.
+
+    ``q``/``k``/``v`` are this chip's (N, H, L_local, d) shards of the
+    L-sharded tensors. ``kv_len`` is the *global* number of valid tokens
+    (None = every position valid). Returns this chip's (N, H, L_local, d)
+    output shard.
+    """
+    axis_size = lax.axis_size(axis_name)
+    axis_index = lax.axis_index(axis_name)
+    l_local = k.shape[2]
+    scale = q.shape[-1] ** -0.5
+    limit = None if kv_len is None else jnp.asarray(kv_len)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, hop):
+        m, l, acc, k_cur, v_cur = carry
+        # k_cur/v_cur started on chip (axis_index - hop): their global
+        # token offset is that source chip's shard offset.
+        src = (axis_index - hop) % axis_size
+        if limit is None:
+            kv_mask = None
+        else:
+            pos = src * l_local + jnp.arange(l_local)
+            kv_mask = (pos < limit)[None, None, None, :]
+        m, l, acc = online_softmax_step(
+            q, k_cur, v_cur, m, l, acc, scale, kv_mask=kv_mask
+        )
+        # Rotate KV shards one hop around the ring (ICI neighbor exchange).
+        # scan needs a uniform carry, so the final hop also permutes; that
+        # last exchange restores the original shard placement.
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    m, l, acc = init_carry(q)
+    if axis_size == 1:
+        (m, l, acc, _, _), _ = step((m, l, acc, k, v), 0)
+    else:
+        (m, l, acc, _, _), _ = lax.scan(
+            step, (m, l, acc, k, v), jnp.arange(axis_size)
+        )
+    return _finalize(m, l, acc, q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "data",
+    kv_len: Optional[jnp.ndarray] = None,
+    head_axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Global-view ring attention: shard_map over ``mesh[axis_name]``.
+
+    Callable from inside a GSPMD-jitted function: L (axis 2) is sharded
+    over ``axis_name``, N/d stay replicated relative to that axis, and
+    the kernel body runs per-shard with explicit ppermute hops. L must
+    divide by the axis size (pad + ``kv_len`` otherwise).
+
+    ``head_axis`` additionally shards the head axis (axis 1) over that
+    mesh axis — the CP x TP composition: Megatron-sharded q/k/v arrive
+    with heads already split over 'model', and the ring runs
+    per-head-shard with no cross-axis traffic.
+    """
+    if q.shape[2] % mesh.shape[axis_name]:
+        raise ValueError(
+            f"token axis {q.shape[2]} not divisible by mesh axis "
+            f"'{axis_name}' ({mesh.shape[axis_name]}); pad and pass kv_len"
+        )
+    if head_axis is not None and q.shape[1] % mesh.shape[head_axis]:
+        raise ValueError(
+            f"head axis {q.shape[1]} not divisible by mesh axis "
+            f"'{head_axis}' ({mesh.shape[head_axis]})"
+        )
+    spec = P(None, head_axis, axis_name, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, kv_len=kv_len),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def make_context_parallel_core(
+    mesh: Mesh, axis_name: str = "data", head_axis: Optional[str] = "model"
+):
+    """An ``attn_core(q, k, v) -> out`` for transformer models running in
+    ``--sharding mesh --mesh_context`` mode (models/clip/model.py).
+
+    Handles the ragged edge: the token axis (e.g. the ViT's grid*grid+1 =
+    50/197 patch tokens) rarely divides the mesh axis, so q/k/v are
+    right-padded to the next multiple, the pad KV positions are masked out
+    of the softmax via ``kv_len``, and the pad query rows are sliced off
+    the result. ``head_axis`` entries absent from the mesh are ignored.
+    """
+    if head_axis is not None and head_axis not in mesh.shape:
+        head_axis = None
+    n = mesh.shape[axis_name]
+
+    def core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+        L = q.shape[2]
+        to = -(-L // n) * n
+        if to != L:
+            pad = ((0, 0), (0, 0), (0, to - L), (0, 0))
+            q_p, k_p, v_p = (jnp.pad(t, pad) for t in (q, k, v))
+        else:
+            q_p, k_p, v_p = q, k, v
+        out = ring_attention_sharded(
+            q_p, k_p, v_p, mesh, axis_name=axis_name,
+            kv_len=None if to == L else L, head_axis=head_axis,
+        )
+        return out[:, :, :L]
+
+    return core

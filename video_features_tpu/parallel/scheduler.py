@@ -55,6 +55,14 @@ def mesh_feature_extraction(extractor, devices: Optional[Sequence] = None) -> No
                 f"specs, which {type(extractor).__name__} does not define "
                 "(only the batch axis shards); use --mesh_model 1"
             )
+        if getattr(extractor.config, "mesh_context", False) and not getattr(
+            extractor, "mesh_context_capable", False
+        ):
+            raise ValueError(
+                f"--mesh_context needs a transformer token axis to shard; "
+                f"{type(extractor).__name__} does not declare support "
+                "(mesh_context_capable)"
+            )
         mesh = make_mesh(devices, model=model_axis)
         extractor(device=mesh)
     finally:

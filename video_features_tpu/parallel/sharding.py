@@ -85,14 +85,17 @@ def shard_params(params, mesh: Mesh, specs=None):
     return jax.device_put(params, shardings)
 
 
-def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data")):
+def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data"),
+                        out_spec=P("data")):
     """jit ``model.apply`` with the batch sharded over 'data'.
 
     Returns ``fn(params, x)``; params should already be placed with
     ``shard_params`` (their shardings flow into the jit as arguments).
+    ``--mesh_context`` mode passes ``P()`` for both: the batch replicates
+    and the token axis shards *inside* the model via ring attention.
     """
     x_sharding = NamedSharding(mesh, batch_spec)
-    out_sharding = NamedSharding(mesh, P("data"))
+    out_sharding = NamedSharding(mesh, out_spec)
 
     @partial(jax.jit, out_shardings=out_sharding)
     def fn(p, x):
