@@ -75,13 +75,18 @@ def bench_clip(n_videos: int, video: str, tmp: str, dtype: str = "float32") -> f
     ex.progress.disable = True
     device = resolve_devices(cfg)[0]
     ex([0], device=device)  # warmup: decode path + XLA compile
-    t0 = time.perf_counter()
-    results = ex(range(n_videos), device=device)
-    dt = time.perf_counter() - t0
+    # best of 3 passes: the axon tunnel's dispatch latency and host-CPU
+    # contention vary minute to minute; the best pass is the machine's
+    # actual capability (BENCH_r02 observed a 3.6x swing between runs)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        results = ex(range(n_videos), device=device)
+        best = min(best, time.perf_counter() - t0)
     assert len(results) == n_videos and all(
         r["CLIP-ViT-B/32"].shape == (12, 512) for r in results
     )
-    return n_videos / dt
+    return n_videos / best
 
 
 def bench_i3d_raft(video: str, tmp: str) -> float:
@@ -101,11 +106,13 @@ def bench_i3d_raft(video: str, tmp: str) -> float:
     ex.progress.disable = True
     device = resolve_devices(cfg)[0]
     ex([0], device=device)  # warmup: RAFT scan + two I3D towers compile
-    t0 = time.perf_counter()
-    (r,) = ex([0], device=device)
-    dt = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(2):  # best-of-2: tunnel/host variance (see bench_clip)
+        t0 = time.perf_counter()
+        (r,) = ex([0], device=device)
+        best = min(best, time.perf_counter() - t0)
     assert r["rgb"].shape[1] == 1024 and r["flow"].shape[1] == 1024
-    return 1.0 / dt
+    return 1.0 / best
 
 
 def bench_pallas_corr() -> dict:
@@ -154,6 +161,54 @@ def bench_pallas_corr() -> dict:
     }
 
 
+def bench_flash_attention() -> dict:
+    """Long-sequence attention: Pallas flash kernel vs the fused
+    full-score-matrix core at L=4096, d=64, 12 heads (the single-chip
+    long-context core; ring attention runs the same recurrence across
+    chips). K calls chained in one jitted scan, per bench_pallas_corr."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.ops.attention import attention
+    from video_features_tpu.ops.pallas.flash_attention import flash_attention
+
+    if jax.default_backend() != "tpu":
+        return {}
+    N, H, L, d = 1, 12, 4096, 64
+    K = 20
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(N, H, L, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(N, H, L, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(N, H, L, d).astype(np.float32))
+
+    def timed(core):
+        @jax.jit
+        def fn(q, k, v):
+            def body(carry, _):
+                acc, q = carry
+                out = core(q, k, v)
+                return (acc + jnp.sum(out), jnp.roll(q, 1, axis=2)), None
+
+            (acc, _), _ = jax.lax.scan(body, (0.0, q), None, length=K)
+            return acc
+
+        float(fn(q, k, v))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn(q, k, v))
+            best = min(best, time.perf_counter() - t0)
+        return best / K
+
+    t_flash = timed(flash_attention)
+    t_fused = timed(attention)
+    return {
+        "flash_attn_us": round(t_flash * 1e6, 1),
+        "fused_attn_us": round(t_fused * 1e6, 1),
+        "flash_attn_speedup_vs_fused": round(t_fused / t_flash, 3),
+    }
+
+
 def main() -> None:
     from video_features_tpu.utils.synth import synth_video
 
@@ -176,6 +231,11 @@ def main() -> None:
         if os.environ.get("BENCH_SKIP_I3D") != "1":
             extra["i3d_raft_vps"] = round(bench_i3d_raft(i3d_video, tmp), 3)
         extra.update(bench_pallas_corr())
+        if os.environ.get("BENCH_FLASH") == "1":
+            # opt-in: the L=4096 flash-attention Mosaic compile has been
+            # observed to crash the axon remote-compile helper, hanging
+            # every later jax call — keep it out of the driver's run
+            extra.update(bench_flash_attention())
 
     clip_base = baselines.get("clip_torch_cpu_vps")
     i3d_base = baselines.get("i3d_raft_torch_cpu_vps")

@@ -1,0 +1,122 @@
+"""Multi-host (multi-controller) execution: two real OS processes form a
+jax.distributed cluster and run collectives across the process boundary.
+
+This is the CPU analog of a two-host TPU slice: each process owns 4
+virtual devices (one host's chips), ``jax.distributed.initialize`` joins
+them into one 8-device runtime (the role JAX_COORDINATOR_ADDRESS plays
+for main.py on a pod), and a shard_map psum + ring attention run over the
+*global* mesh — the collectives cross processes, which is exactly what
+rides DCN/ICI on real multi-host slices. The reference has no multi-host
+story at all (SURVEY.md §2: comms backend 'None'; its workers never
+exchange tensors).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+port, proc_id = sys.argv[1], int(sys.argv[2])
+
+import numpy as np
+import jax
+
+# jax may already be imported by a sitecustomize that captured the env at
+# interpreter start — re-pin cpu through the config API so the axon
+# plugin's backend discovery (which dials the chip tunnel) never runs
+# (see tests/conftest.py / parallel/devices.py::pin_platform)
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=proc_id
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.local_devices()) == 4
+assert len(jax.devices()) == 8, "global device view must span both processes"
+
+from video_features_tpu.parallel.ring_attention import ring_attention_sharded
+from video_features_tpu.parallel.sharding import make_mesh
+
+mesh = make_mesh(jax.devices(), data=8, model=1)
+
+# 1) cross-process psum: every device contributes its shard; the reduction
+#    crosses the process boundary (DCN on a real pod)
+rows = np.arange(8, dtype=np.float32) + 1.0  # global: [1..8]
+sh = NamedSharding(mesh, P("data"))
+x = jax.make_array_from_process_local_data(sh, rows[proc_id * 4:(proc_id + 1) * 4])
+total = jax.jit(
+    jax.shard_map(
+        lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(),
+    ),
+    out_shardings=NamedSharding(mesh, P()),
+)(x)
+np.testing.assert_allclose(np.asarray(total), [36.0])
+
+# 2) ring attention over the global mesh: KV shards ppermute around an
+#    8-stop ring that alternates between the two processes
+rng = np.random.default_rng(0)
+N, H, L, d = 1, 2, 64, 8
+q, k, v = (rng.standard_normal((N, H, L, d)).astype(np.float32) for _ in range(3))
+spec = P(None, None, "data", None)
+shq = NamedSharding(mesh, spec)
+lo, hi = proc_id * (L // 2), (proc_id + 1) * (L // 2)
+qs, ks, vs = (
+    jax.make_array_from_process_local_data(shq, t[:, :, lo:hi]) for t in (q, k, v)
+)
+out = jax.jit(
+    lambda a, b, c: ring_attention_sharded(a, b, c, mesh, axis_name="data"),
+    out_shardings=NamedSharding(mesh, P()),
+)(qs, ks, vs)
+
+# numpy oracle, fully local
+s = np.einsum("nhqd,nhkd->nhqk", q, k) * d ** -0.5
+p = np.exp(s - s.max(-1, keepdims=True))
+ref = np.einsum("nhqk,nhkd->nhqd", p / p.sum(-1, keepdims=True), v)
+np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+print(f"proc {proc_id} ok")
+"""
+
+
+@pytest.mark.timeout(300)
+def test_two_process_cluster_runs_cross_host_collectives(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_COORDINATOR_ADDRESS"}
+    # 4 virtual cpu devices per process = one simulated host each; must be
+    # in the env BEFORE the interpreter starts (a sitecustomize may import
+    # jax at startup, capturing these)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["USE_TF"] = "0"
+    env["PYTHONPATH"] = (
+        "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"proc {i} ok" in out

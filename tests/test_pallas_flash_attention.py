@@ -1,0 +1,55 @@
+"""Pallas flash-attention kernel vs the fused core (interpret mode on CPU;
+the same kernel runs compiled on TPU — bench.py microbenches it there)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from video_features_tpu.ops.attention import attention
+from video_features_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(rng, n=2, h=3, lq=64, lk=64, d=32, dtype=np.float32):
+    q = rng.standard_normal((n, h, lq, d)).astype(dtype)
+    k = rng.standard_normal((n, h, lk, d)).astype(dtype)
+    v = rng.standard_normal((n, h, lk, d)).astype(dtype)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("bq,bk", [(16, 16), (32, 64), (64, 16)])
+def test_flash_matches_fused(bq, bk):
+    q, k, v = _qkv(np.random.default_rng(0), lq=96, lk=128)
+    ref = attention(q, k, v)
+    out = flash_attention(q, k, v, block_q=bq, block_k=bk, interpret=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_ragged_lengths_pad_and_mask():
+    """L not a block multiple + explicit kv_len: pads masked, rows sliced."""
+    q, k, v = _qkv(np.random.default_rng(1), lq=50, lk=50)
+    ref = attention(q, k[:, :, :37], v[:, :, :37])
+    out = flash_attention(
+        q, k, v, block_q=16, block_k=16, kv_len=37, interpret=True
+    )
+    assert out.shape == q.shape
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_bf16_fp32_accumulation():
+    q, k, v = _qkv(np.random.default_rng(2))
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    out = flash_attention(qb, kb, vb, block_q=32, block_k=32, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = attention(q, k, v)
+    assert np.allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_flash_single_block():
+    """Whole sequence in one (block_q, block_k): degenerate grid."""
+    q, k, v = _qkv(np.random.default_rng(3), lq=16, lk=16)
+    ref = attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -163,9 +163,9 @@ class ExtractCLIP(BaseExtractor):
             padded = pad_batch_for(state["device"], padded)
             x = place_batch(padded, state["device"])
         else:  # mesh_context: batch replicates, tokens shard in-model
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
-            x = jax.device_put(padded, NamedSharding(state["device"], P()))
+            x = place_batch(padded, state["device"], spec=P())
         feats = np.asarray(state["encode_image"](state["params"], x))[:T]
         return {
             self.feature_type: feats,

@@ -1,0 +1,135 @@
+"""Pallas TPU flash-attention kernel: the single-chip long-sequence core.
+
+The reference's attention (torch ``nn.MultiheadAttention`` inside the pip
+``clip`` package, ref models/CLIP/extract_clip.py:46-63) materializes the
+full (L, L) score matrix in HBM. This kernel never does: for each Q tile
+resident in VMEM it streams KV tiles through VMEM, maintaining the
+FlashAttention online-softmax accumulator (running max / sum / weighted
+value) in fp32 VMEM scratch, and writes each output tile exactly once.
+Peak memory is O(block_q * block_k) scores, so sequence length is bounded
+by HBM for K/V storage only — the same recurrence
+ops/attention.py::blockwise_attention runs as an XLA scan and
+parallel/ring_attention.py runs across chips; this is its MXU form:
+
+- grid (N*H, Lq/block_q, Lkv/block_k), KV innermost — TPU grids run
+  sequentially, so the fp32 scratch carries across KV steps and resets
+  when the KV index wraps to 0.
+- both matmuls (`q @ k^T`, `p @ v`) hit the MXU with
+  ``preferred_element_type=float32``; q/k/v may be bf16.
+- right-padding on the KV axis (to a block multiple, or a caller's
+  ``kv_len``) is masked to -1e30 before the row-max, mirroring
+  ops/attention.py::_MASK_VALUE semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MASK_VALUE = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+            *, scale: float, block_k: int, kv_len: int):
+    kv_i = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK_VALUE)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # (block_q, d)
+    k = k_ref[0]  # (block_k, d)
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (block_q, block_k) fp32 on the MXU
+    pos = kv_i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < kv_len, s, _MASK_VALUE)
+
+    m_prev = m_scr[...]  # (block_q, 1)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)  # (block_q, block_k)
+    l_scr[...] = l_scr[...] * corr + p.sum(axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[...] = acc_scr[...] * corr + pv
+    m_scr[...] = m_new
+
+    @pl.when(kv_i == nk - 1)
+    def _finalize():
+        out = acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_q", "block_k", "kv_len", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_q: int = 256,
+    block_k: int = 512,
+    kv_len: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(N, H, L, d) q/k/v -> (N, H, Lq, d); fp32-exact vs the fused core.
+
+    ``kv_len`` masks KV positions >= kv_len (the ragged-token case);
+    Q/KV axes are right-padded to block multiples internally and pad
+    query rows are sliced off the result.
+    """
+    N, H, Lq, d = q.shape
+    Lk = k.shape[2]
+    scale = d ** -0.5
+    # shrink blocks to short sequences, keeping the 8-sublane alignment
+    # Mosaic tiling wants (the pad rows a rounded-up block adds are sliced
+    # off / masked anyway)
+    block_q = min(block_q, -(-Lq // 8) * 8)
+    block_k = min(block_k, -(-Lk // 8) * 8)
+    nq = pl.cdiv(Lq, block_q)
+    nk = pl.cdiv(Lk, block_k)
+    limit = Lk if kv_len is None else kv_len
+
+    qp = q.reshape(N * H, Lq, d)
+    kp = k.reshape(N * H, Lk, d)
+    vp = v.reshape(N * H, Lk, d)
+    if nq * block_q != Lq:
+        qp = jnp.pad(qp, ((0, 0), (0, nq * block_q - Lq), (0, 0)))
+    if nk * block_k != Lk:
+        pad = ((0, 0), (0, nk * block_k - Lk), (0, 0))
+        kp = jnp.pad(kp, pad)
+        vp = jnp.pad(vp, pad)
+
+    kernel = functools.partial(
+        _kernel, scale=scale, block_k=block_k, kv_len=limit
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(N * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((N * H, nq * block_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :Lq].reshape(N, H, Lq, d)

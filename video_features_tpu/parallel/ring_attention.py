@@ -80,12 +80,9 @@ def ring_attention(
         return (m, l, acc, k_nxt, v_nxt), None
 
     m, l, acc = init_carry(q)
-    if axis_size == 1:
-        (m, l, acc, _, _), _ = step((m, l, acc, k, v), 0)
-    else:
-        (m, l, acc, _, _), _ = lax.scan(
-            step, (m, l, acc, k, v), jnp.arange(axis_size)
-        )
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m, l, acc, k, v), jnp.arange(axis_size)
+    )
     return _finalize(m, l, acc, q.dtype)
 
 
@@ -110,11 +107,12 @@ def ring_attention_sharded(
     with heads already split over 'model', and the ring runs
     per-head-shard with no cross-axis traffic.
     """
-    if q.shape[2] % mesh.shape[axis_name]:
-        raise ValueError(
-            f"token axis {q.shape[2]} not divisible by mesh axis "
-            f"'{axis_name}' ({mesh.shape[axis_name]}); pad and pass kv_len"
-        )
+    for name, t in (("q", q), ("k", k), ("v", v)):
+        if t.shape[2] % mesh.shape[axis_name]:
+            raise ValueError(
+                f"{name} token axis {t.shape[2]} not divisible by mesh axis "
+                f"'{axis_name}' ({mesh.shape[axis_name]}); pad and pass kv_len"
+            )
     if head_axis is not None and q.shape[1] % mesh.shape[head_axis]:
         raise ValueError(
             f"head axis {q.shape[1]} not divisible by mesh axis "
