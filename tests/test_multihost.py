@@ -12,11 +12,12 @@ exchange tensors).
 """
 
 import os
+import pathlib
 import socket
 import subprocess
 import sys
 
-import pytest
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
 
 _WORKER = r"""
 import os, sys
@@ -84,7 +85,6 @@ print(f"proc {proc_id} ok")
 """
 
 
-@pytest.mark.timeout(300)
 def test_two_process_cluster_runs_cross_host_collectives(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -98,7 +98,7 @@ def test_two_process_cluster_runs_cross_host_collectives(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env["USE_TF"] = "0"
     env["PYTHONPATH"] = (
-        "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+        _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     ).rstrip(os.pathsep)
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)

@@ -377,3 +377,54 @@ def test_decode_workers_pipeline_outputs_identical(sample_video, tmp_path):
     for s, p in zip(serial, piped):
         np.testing.assert_array_equal(s["resnet18"], p["resnet18"])
         np.testing.assert_array_equal(s["timestamps_ms"], p["timestamps_ms"])
+
+
+def test_device_pipeline_split_outputs_identical(sample_video):
+    """CLIP's dispatch/fetch split (one video's transfer+compute in
+    flight while the previous fetches) is a pure scheduling change."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    def run(workers):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="CLIP-ViT-B/32",
+            video_paths=[sample_video] * 4,
+            extract_method="uni_12",
+            decode_workers=workers,
+            cpu=True,
+        )
+        ex = ExtractCLIP(cfg, external_call=True)
+        ex.progress.disable = True
+        assert ex._supports_device_pipeline()
+        return ex(range(4))
+
+    serial = run(0)
+    piped = run(2)
+    assert len(serial) == len(piped) == 4
+    for s, p in zip(serial, piped):
+        np.testing.assert_array_equal(s["CLIP-ViT-B/32"], p["CLIP-ViT-B/32"])
+
+
+def test_device_pipeline_isolates_corrupt_video(sample_video, tmp_path):
+    """A corrupt video mid-list must not break the in-flight pipeline:
+    the other videos complete, the bad one is skipped, progress counts
+    every video exactly once."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    bad = tmp_path / "corrupt.mp4"
+    bad.write_bytes(b"not a video at all")
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="CLIP-ViT-B/32",
+        video_paths=[sample_video, str(bad), sample_video],
+        extract_method="uni_12",
+        decode_workers=2,
+        cpu=True,
+    )
+    ex = ExtractCLIP(cfg, external_call=True)
+    ex.progress.disable = True
+    results = ex(range(3))
+    assert len(results) == 2  # the two good videos; the bad one skipped
+    np.testing.assert_array_equal(
+        results[0]["CLIP-ViT-B/32"], results[1]["CLIP-ViT-B/32"]
+    )

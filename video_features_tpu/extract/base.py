@@ -149,6 +149,14 @@ class BaseExtractor:
                     self.config.output_direct,
                 )
 
+    def _report_video_error(self, entry) -> None:
+        """The per-video failure contract: print, continue, count the
+        video as handled (shared by _isolate and the dispatch phase)."""
+        print(f"An error occurred extracting {video_path_of(entry)}:")
+        traceback.print_exc()
+        print("Continuing...")
+        self.progress.update()
+
     def _isolate(self, entry, fn, *args) -> None:
         """Per-video error isolation (ref extract_clip.py:78-84)."""
         try:
@@ -156,9 +164,8 @@ class BaseExtractor:
         except KeyboardInterrupt:
             raise
         except Exception:  # noqa: BLE001
-            print(f"An error occurred extracting {video_path_of(entry)}:")
-            traceback.print_exc()
-            print("Continuing...")
+            self._report_video_error(entry)
+            return
         self.progress.update()
 
     def __call__(
@@ -225,10 +232,39 @@ class BaseExtractor:
                 return self.prepare(entry)
 
         pending: deque = deque()
+        # device pipeline (extractors with the dispatch/fetch split): one
+        # video's transfer+compute stays in flight while the previous
+        # video's results are fetched/sunk
+        split = self._supports_device_pipeline()
+        inflight: deque = deque()  # (entry, handle)
+
+        def fetch_one():
+            entry, handle = inflight.popleft()
+
+            def one():
+                with self.timer.stage("device"):
+                    feats_dict = self.fetch_dispatched(handle)
+                self._sink_or_collect(feats_dict, entry, results)
+
+            self._isolate(entry, one)
 
         def consume_one():
             idx, fut = pending.popleft()
             entry = self.path_list[idx]
+            if split:
+                try:
+                    payload = fut.result()
+                    with self.timer.stage("device"):
+                        inflight.append(
+                            (entry, self.dispatch_prepared(device, state, entry, payload))
+                        )
+                except KeyboardInterrupt:
+                    raise
+                except Exception:  # noqa: BLE001 - same per-video isolation
+                    self._report_video_error(entry)
+                if len(inflight) > 1:
+                    fetch_one()
+                return
 
             def one():
                 payload = fut.result()
@@ -255,6 +291,8 @@ class BaseExtractor:
                     consume_one()
             while pending:
                 consume_one()
+            while inflight:
+                fetch_one()
 
     def _probe_done_safe(self, entry) -> bool:
         try:
@@ -284,5 +322,28 @@ class BaseExtractor:
         raise NotImplementedError
 
     def extract_prepared(self, device, state, path_entry, payload):
-        """Device-side half: consume ``prepare``'s payload."""
+        """Device-side half: consume ``prepare``'s payload. Extractors
+        that split further into ``dispatch_prepared``+``fetch_dispatched``
+        get this composition for free."""
+        if self._supports_device_pipeline():
+            return self.fetch_dispatched(
+                self.dispatch_prepared(device, state, path_entry, payload)
+            )
+        raise NotImplementedError
+
+    def _supports_device_pipeline(self) -> bool:
+        return type(self).dispatch_prepared is not BaseExtractor.dispatch_prepared
+
+    def dispatch_prepared(self, device, state, path_entry, payload):
+        """Optional split of ``extract_prepared``: enqueue the host->HBM
+        transfer and the jitted forward (XLA dispatch is async) and return
+        a handle WITHOUT fetching results. The pipelined loop then starts
+        video k+1's transfer+compute before blocking on video k's fetch —
+        transfers and compute overlap the result fetch, which matters
+        most when host<->device latency is high (tunnel, DCN)."""
+        raise NotImplementedError
+
+    def fetch_dispatched(self, handle):
+        """Blocking half: fetch the dispatched results to host numpy and
+        assemble the feats_dict."""
         raise NotImplementedError

@@ -154,8 +154,10 @@ class ExtractCLIP(BaseExtractor):
         padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
         return padded, T, fps, timestamps_ms
 
-    # device half: transfer + jitted encode
-    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+    # device half, split for the device pipeline (extract/base.py): enqueue
+    # transfer + async forward, fetch later — video k+1's transfer/compute
+    # overlaps video k's result fetch
+    def dispatch_prepared(self, device, state, path_entry, payload):
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
         padded, T, fps, timestamps_ms = payload
@@ -166,9 +168,12 @@ class ExtractCLIP(BaseExtractor):
             from jax.sharding import PartitionSpec as P
 
             x = place_batch(padded, state["device"], spec=P())
-        feats = np.asarray(state["encode_image"](state["params"], x))[:T]
+        return state["encode_image"](state["params"], x), T, fps, timestamps_ms
+
+    def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
+        out, T, fps, timestamps_ms = handle
         return {
-            self.feature_type: feats,
+            self.feature_type: np.asarray(out)[:T],
             "fps": np.array(fps),
             "timestamps_ms": np.array(timestamps_ms),
         }

@@ -137,25 +137,35 @@ class ExtractR21D(BaseExtractor):
             batches.append((pad_batch(stacks, self.batch_size), stacks.shape[0]))
         return batches, slices
 
-    # device half: transfer + fused preprocess/forward per window batch
-    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
-        video_path = video_path_of(path_entry)
+    # device half, split for the device pipeline (extract/base.py): every
+    # window batch's transfer + fused preprocess/forward is dispatched
+    # (async under XLA), results stay on device until fetch — the next
+    # video's dispatches overlap this video's fetch
+    def dispatch_prepared(self, device, state, path_entry, payload):
         batches, slices = payload
         if not slices:
-            return {self.feature_type: np.zeros((0, R21D_FEATURE_DIM), np.float32)}
-
+            return path_entry, [], slices
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
-        feats_out, logits_out = [], []
+        outs = []
         for padded, n in batches:
             padded = pad_batch_for(state["device"], padded)
             x = place_batch(padded, state["device"])
             feats, logits = state["forward"](state["params"], x)
+            outs.append((feats, logits, n))
+        return path_entry, outs, slices
+
+    def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
+        path_entry, outs, slices = handle
+        if not slices:
+            return {self.feature_type: np.zeros((0, R21D_FEATURE_DIM), np.float32)}
+        feats_out, logits_out = [], []
+        for feats, logits, n in outs:
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:
                 logits_out.append(np.asarray(logits)[:n])
-
         if self.config.show_pred:
+            video_path = video_path_of(path_entry)
             logits_all = np.concatenate(logits_out, axis=0)
             for i, (start, end) in enumerate(slices):
                 print(f"{video_path} @ frames ({start}, {end})")

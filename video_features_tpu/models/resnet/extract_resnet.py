@@ -183,17 +183,31 @@ class ExtractResNet(BaseExtractor):
         }
 
     # device half: transfer + jitted forward per batch
-    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+    # split for the device pipeline (extract/base.py): all frame batches
+    # dispatch async, results fetched while the next video transfers.
+    # The too-big-to-prefetch "stream" fallback cannot defer (it decodes
+    # interleaved with compute), so it completes eagerly at dispatch and
+    # fetch passes the ready dict through.
+    def dispatch_prepared(self, device, state, path_entry, payload):
         if payload[0] == "stream":
-            return self._extract_streaming(state, payload[1])
+            return ("done", self._extract_streaming(state, payload[1]))
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
         batches, counts, actual_fps, timestamps_ms = payload
-        feats_out: List[np.ndarray] = []
+        outs = []
         for x, n in zip(batches, counts):
             x = pad_batch_for(state["device"], x)
             x = place_batch(x, state["device"])
             feats, logits = state["forward"](state["params"], x)
+            outs.append((feats, logits, n))
+        return "batched", outs, actual_fps, timestamps_ms
+
+    def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
+        if handle[0] == "done":
+            return handle[1]
+        _, outs, actual_fps, timestamps_ms = handle
+        feats_out: List[np.ndarray] = []
+        for feats, logits, n in outs:
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:
                 show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")

@@ -9,7 +9,8 @@ axis, keep every chip's K/V shard resident, and rotate K/V shards around
 the ICI ring with ``lax.ppermute`` while each chip folds them into the
 FlashAttention online-softmax accumulator (ops/attention.py). After
 ``axis_size`` hops every Q shard has seen every KV shard: the result is
-*bit-identical math* to full attention, with O(L/n) activation memory per
+mathematically exact vs full attention (same softmax, different fp
+accumulation order — tests assert 1e-5), with O(L/n) activation memory per
 chip and compute/communication overlapped by XLA's async collective
 scheduling.
 
