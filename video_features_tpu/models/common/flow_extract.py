@@ -120,12 +120,13 @@ class PairwiseFlowExtractor(BaseExtractor):
             frame = pil_resize(frame, int(self.side_size), self.resize_to_smaller_edge)
         return frame.astype(np.float32)
 
-    def _run_batch(
-        self, state, batch: List[np.ndarray], padder, flows: List[np.ndarray]
-    ) -> None:
+    def _dispatch_batch(self, state, batch: List[np.ndarray], padder):
+        """Enqueue one B+1-frame window (async under XLA); the result is
+        fetched lazily by ``_fetch_batch`` with a one-batch lag so the
+        device computes window k+1 while window k's flow copies out."""
         n_pairs = len(batch) - 1
         if n_pairs < 1:
-            return
+            return None
         from video_features_tpu.parallel.sharding import is_mesh, place_batch
 
         # one static window length per run: B+1 frames, rounded up on a
@@ -140,10 +141,16 @@ class PairwiseFlowExtractor(BaseExtractor):
         window = batch + [batch[-1]] * (target_len - len(batch))
         x = padder.pad(np.stack(window))
         x = place_batch(x, state["device"])
-        flow = np.asarray(state["forward"](state["params"], x))  # (B, Hp, Wp, 2)
-        flow = padder.unpad(flow)[:n_pairs]
+        out = state["forward"](state["params"], x)  # (B, Hp, Wp, 2) on device
+        return out, n_pairs, (batch if self.config.show_pred else None)
+
+    def _fetch_batch(self, pending, padder, flows: List[np.ndarray]) -> None:
+        if pending is None:
+            return
+        out, n_pairs, batch = pending
+        flow = padder.unpad(np.asarray(out))[:n_pairs]
         flows.extend(np.transpose(flow, (0, 3, 1, 2)))  # saved as (2, H, W)
-        if self.config.show_pred:
+        if batch is not None:
             from video_features_tpu.utils.flow_viz import show_flow_on_frame
 
             for i in range(n_pairs):
@@ -158,6 +165,7 @@ class PairwiseFlowExtractor(BaseExtractor):
         timestamps_ms: List[float] = []
         batch: List[np.ndarray] = []
         padder = None
+        pending = None  # lag-1 window: fetch k after dispatching k+1
         for frame, ts in stream_frames(
             video_path, self.config.extraction_fps, self.config.decoder
         ):
@@ -168,10 +176,15 @@ class PairwiseFlowExtractor(BaseExtractor):
             batch.append(frame)
             # B+1 frames make B pairs; the boundary frame carries over
             if len(batch) - 1 == self.batch_size:
-                self._run_batch(state, batch, padder, flows)
+                nxt = self._dispatch_batch(state, batch, padder)
+                self._fetch_batch(pending, padder, flows)
+                pending = nxt
                 batch = [batch[-1]]
         if len(batch) > 1:
-            self._run_batch(state, batch, padder, flows)
+            nxt = self._dispatch_batch(state, batch, padder)
+            self._fetch_batch(pending, padder, flows)
+            pending = nxt
+        self._fetch_batch(pending, padder, flows)
         if padder is None:
             raise IOError(f"no frames decoded from {video_path}")
 

@@ -311,8 +311,30 @@ class ExtractI3D(BaseExtractor):
         return imgs
 
     # --- main --------------------------------------------------------------
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+    # split as prepare (host decode/resize, runs on --decode_workers
+    # threads) + dispatch/fetch (extract/base.py device pipeline). Inside
+    # dispatch, stack k's results fetch only after stack k+1 is enqueued
+    # (lag-1): the fetch overlaps the next stack's RAFT/PWC+I3D compute,
+    # and at most ~2 stacks' inputs are ever resident in HBM regardless
+    # of video length (the fetch is the backpressure).
+    # host-RAM guard: a prepared video is T x 256 x W x 3 float32; the
+    # pipeline keeps decode_workers+2 of them resident. Beyond this cap,
+    # decode moves into the dispatch phase (one video at a time — the old
+    # serial memory profile), same pattern as ResNet's streaming fallback.
+    PIPELINE_MAX_FRAMES = 4096
+
+    def _decode_resized(self, video_path):
+        frames, fps, timestamps_ms = self._sample_frames(video_path)
+        if not frames:
+            raise IOError(f"no frames decoded from {video_path}")
+        frames = [
+            pil_resize(f, MIN_SIDE_SIZE).astype(np.float32) for f in frames
+        ]
+        return frames, fps, timestamps_ms
+
+    def prepare(self, path_entry):
         from_disk = self.flow_type == "flow"
+        flow_imgs = None
         if from_disk:
             if not isinstance(path_entry, (tuple, list)) or len(path_entry) != 2:
                 raise ValueError(
@@ -321,24 +343,30 @@ class ExtractI3D(BaseExtractor):
                 )
             flow_imgs = self._read_flow_images(path_entry[1])
         video_path = video_path_of(path_entry)
-        frames, fps, timestamps_ms = self._sample_frames(video_path)
-        if not frames:
-            raise IOError(f"no frames decoded from {video_path}")
-        frames = [
-            pil_resize(f, MIN_SIDE_SIZE).astype(np.float32) for f in frames
-        ]
+        if probe(video_path, self.config.decoder).frame_count > self.PIPELINE_MAX_FRAMES:
+            return None, flow_imgs, from_disk  # too big to prefetch whole
+        return self._decode_resized(video_path), flow_imgs, from_disk
+
+    def dispatch_prepared(self, device, state, path_entry, payload):
+        decoded, flow_imgs, from_disk = payload
+        if decoded is None:  # over the prefetch cap: decode here, held once
+            decoded = self._decode_resized(video_path_of(path_entry))
+        frames, fps, timestamps_ms = decoded
         fns = self._fns_for_shape(state, frames[0].shape[:2])
 
         feats: Dict[str, List[np.ndarray]] = {s: [] for s in self.streams}
+        preds: List[tuple] = []  # (stack_idx, stream, logits) if show_pred
         window = self.stack_size + (0 if from_disk else 1)
         # with disk flow the reference zips frames with flow pairs, so the
         # windowed extent truncates to the shorter (ref extract_i3d.py:266)
         extent = min(len(frames), len(flow_imgs)) if from_disk else len(frames)
+        pending = None
         for stack_counter, (start, end) in enumerate(
             form_slices(extent, window, self.step_size)
         ):
             stack = np.stack(frames[start:end])
             x = jax.device_put(jnp.asarray(stack), state["device"])
+            outs = []
             for stream in self.streams:
                 if stream == "rgb":
                     f, logits = fns["rgb"](state["params"]["rgb"], x)
@@ -351,11 +379,28 @@ class ExtractI3D(BaseExtractor):
                     f, logits = fns["flow"](
                         state["params"][self.flow_type], state["params"]["flow"], x
                     )
-                feats[stream].append(np.asarray(f)[0])
-                if self.config.show_pred:
-                    print(f"{video_path} @ stack {stack_counter} ({stream} stream)")
-                    show_predictions_on_dataset(np.asarray(logits)[0], "kinetics")
+                outs.append(
+                    (stream, f, logits if self.config.show_pred else None)
+                )
+            if pending is not None:
+                self._fetch_stack(pending, feats, preds)  # overlaps this stack
+            pending = (stack_counter, outs)
+        return feats, preds, pending, video_path_of(path_entry), fps, timestamps_ms
 
+    def _fetch_stack(self, pending, feats, preds) -> None:
+        stack_idx, outs = pending
+        for stream, f, logits in outs:
+            feats[stream].append(np.asarray(f)[0])
+            if logits is not None:
+                preds.append((stack_idx, stream, np.asarray(logits)[0]))
+
+    def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
+        feats, preds, pending, video_path, fps, timestamps_ms = handle
+        if pending is not None:
+            self._fetch_stack(pending, feats, preds)
+        for stack_idx, stream, logits in preds:
+            print(f"{video_path} @ stack {stack_idx} ({stream} stream)")
+            show_predictions_on_dataset(logits, "kinetics")
         out: Dict[str, np.ndarray] = {
             s: np.array(feats[s], dtype=np.float32).reshape(-1, 1024)
             for s in self.streams

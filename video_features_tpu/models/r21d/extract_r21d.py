@@ -152,7 +152,9 @@ class ExtractR21D(BaseExtractor):
             padded = pad_batch_for(state["device"], padded)
             x = place_batch(padded, state["device"])
             feats, logits = state["forward"](state["params"], x)
-            outs.append((feats, logits, n))
+            # drop logits unless show_pred needs them — the handle pins
+            # its buffers until fetch
+            outs.append((feats, logits if self.config.show_pred else None, n))
         return path_entry, outs, slices
 
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
@@ -162,7 +164,7 @@ class ExtractR21D(BaseExtractor):
         feats_out, logits_out = [], []
         for feats, logits, n in outs:
             feats_out.append(np.asarray(feats)[:n])
-            if self.config.show_pred:
+            if logits is not None:
                 logits_out.append(np.asarray(logits)[:n])
         if self.config.show_pred:
             video_path = video_path_of(path_entry)

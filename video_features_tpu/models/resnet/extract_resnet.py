@@ -199,7 +199,9 @@ class ExtractResNet(BaseExtractor):
             x = pad_batch_for(state["device"], x)
             x = place_batch(x, state["device"])
             feats, logits = state["forward"](state["params"], x)
-            outs.append((feats, logits, n))
+            # drop the 1000-class logits unless show_pred needs them —
+            # the handle pins its buffers until fetch
+            outs.append((feats, logits if self.config.show_pred else None, n))
         return "batched", outs, actual_fps, timestamps_ms
 
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
@@ -209,7 +211,7 @@ class ExtractResNet(BaseExtractor):
         feats_out: List[np.ndarray] = []
         for feats, logits, n in outs:
             feats_out.append(np.asarray(feats)[:n])
-            if self.config.show_pred:
+            if logits is not None:
                 show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
         return {
             self.feature_type: np.concatenate(feats_out, axis=0),
