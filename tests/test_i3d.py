@@ -230,3 +230,39 @@ def test_i3d_pipelined_outputs_identical(sample_video):
     for s, p in zip(serial, piped):
         np.testing.assert_array_equal(s["rgb"], p["rgb"])
         np.testing.assert_array_equal(s["timestamps_ms"], p["timestamps_ms"])
+
+
+def test_i3d_over_cap_video_defers_decode(sample_video, monkeypatch):
+    """Videos whose sampled frame count exceeds PIPELINE_MAX_FRAMES skip
+    host prefetch (decode happens in the dispatch phase) but produce
+    identical features."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def run():
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            flow_type="raft",
+            streams=["rgb"],
+            video_paths=[sample_video] * 2,
+            stack_size=10,
+            step_size=24,
+            decode_workers=2,
+            cpu=True,
+        )
+        ex = ExtractI3D(cfg, external_call=True)
+        ex.progress.disable = True
+        payload = ex.prepare(ex.path_list[0])
+        return ex, payload
+
+    ex, payload = run()
+    assert payload[0] is not None  # under the cap: prefetched
+    ref = ex(range(2))
+
+    monkeypatch.setattr(ExtractI3D, "PIPELINE_MAX_FRAMES", 5)
+    ex2, payload2 = run()
+    assert payload2 == (None, None, False)  # over the cap: deferred
+    out = ex2(range(2))
+    for s, p in zip(ref, out):
+        np.testing.assert_array_equal(s["rgb"], p["rgb"])

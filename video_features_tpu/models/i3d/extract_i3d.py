@@ -240,22 +240,30 @@ class ExtractI3D(BaseExtractor):
         return fns
 
     # --- decode ------------------------------------------------------------
-    def _sample_frames(self, video_path: str):
+    def _sampled_count(self, meta) -> int:
+        """How many frames the I3D grid will sample — the prefetch guard's
+        resident-cost estimate (NOT the container frame count: a long
+        video at low --extraction_fps samples few frames)."""
+        fps = meta.fps or 25.0
+        if self.config.extraction_fps is not None:
+            return max(int(meta.frame_count / fps * self.config.extraction_fps), 1)
+        if meta.frame_count < DEFAULT_STACK_SIZE + 1:
+            return DEFAULT_STACK_SIZE + 1
+        return meta.frame_count
+
+    def _sample_frames(self, video_path: str, meta=None):
         """The reference's I3D-specific sampling grid
         (ref extract_i3d.py:239-259): fps-linspace / short-video
         upsample-to-65 / all frames. Returns (frames, fps, timestamps_ms)."""
-        meta = probe(video_path, self.config.decoder)
+        meta = meta or probe(video_path, self.config.decoder)
         fps = meta.fps or 25.0
         frame_cnt = meta.frame_count
         mspf = 1000.0 / fps
-        if self.config.extraction_fps is not None:
-            samples_num = max(int(frame_cnt / fps * self.config.extraction_fps), 1)
-            samples_ix = np.linspace(1, max(frame_cnt - 1, 1), samples_num).astype(int)
-        elif frame_cnt < DEFAULT_STACK_SIZE + 1:
-            samples_num = DEFAULT_STACK_SIZE + 1
-            samples_ix = np.linspace(1, max(frame_cnt - 1, 1), samples_num).astype(int)
-        else:
+        samples_num = self._sampled_count(meta)
+        if self.config.extraction_fps is None and frame_cnt >= DEFAULT_STACK_SIZE + 1:
             samples_ix = np.arange(frame_cnt)
+        else:
+            samples_ix = np.linspace(1, max(frame_cnt - 1, 1), samples_num).astype(int)
 
         wanted = read_frames_at_indices(video_path, samples_ix, self.config.decoder)
         # undecodable sampled indices are dropped, exactly like the
@@ -323,8 +331,8 @@ class ExtractI3D(BaseExtractor):
     # serial memory profile), same pattern as ResNet's streaming fallback.
     PIPELINE_MAX_FRAMES = 4096
 
-    def _decode_resized(self, video_path):
-        frames, fps, timestamps_ms = self._sample_frames(video_path)
+    def _decode_resized(self, video_path, meta=None):
+        frames, fps, timestamps_ms = self._sample_frames(video_path, meta)
         if not frames:
             raise IOError(f"no frames decoded from {video_path}")
         frames = [
@@ -334,22 +342,27 @@ class ExtractI3D(BaseExtractor):
 
     def prepare(self, path_entry):
         from_disk = self.flow_type == "flow"
-        flow_imgs = None
-        if from_disk:
-            if not isinstance(path_entry, (tuple, list)) or len(path_entry) != 2:
-                raise ValueError(
-                    "--flow_type flow needs (video, flow_dir) pairs; provide "
-                    "--flow_paths / --flow_dir alongside the videos"
-                )
-            flow_imgs = self._read_flow_images(path_entry[1])
+        if from_disk and (
+            not isinstance(path_entry, (tuple, list)) or len(path_entry) != 2
+        ):
+            raise ValueError(
+                "--flow_type flow needs (video, flow_dir) pairs; provide "
+                "--flow_paths / --flow_dir alongside the videos"
+            )
         video_path = video_path_of(path_entry)
-        if probe(video_path, self.config.decoder).frame_count > self.PIPELINE_MAX_FRAMES:
-            return None, flow_imgs, from_disk  # too big to prefetch whole
-        return self._decode_resized(video_path), flow_imgs, from_disk
+        meta = probe(video_path, self.config.decoder)
+        if self._sampled_count(meta) > self.PIPELINE_MAX_FRAMES:
+            # too big to prefetch whole: frames AND disk flow defer to the
+            # dispatch phase (one over-cap video resident at a time)
+            return None, None, from_disk
+        flow_imgs = self._read_flow_images(path_entry[1]) if from_disk else None
+        return self._decode_resized(video_path, meta), flow_imgs, from_disk
 
     def dispatch_prepared(self, device, state, path_entry, payload):
         decoded, flow_imgs, from_disk = payload
-        if decoded is None:  # over the prefetch cap: decode here, held once
+        if decoded is None:  # over the prefetch cap: load here, held once
+            if from_disk:
+                flow_imgs = self._read_flow_images(path_entry[1])
             decoded = self._decode_resized(video_path_of(path_entry))
         frames, fps, timestamps_ms = decoded
         fns = self._fns_for_shape(state, frames[0].shape[:2])

@@ -81,13 +81,19 @@ class ExtractVGGish(BaseExtractor):
         )
         return x, n
 
-    # device half: transfer + jitted VGG forward
-    def extract_prepared(self, device, state, path_entry, payload) -> Dict[str, np.ndarray]:
+    # device half, split for the device pipeline (extract/base.py):
+    # transfer + async jitted VGG forward at dispatch, fetch later
+    def dispatch_prepared(self, device, state, path_entry, payload):
         x, n = payload
+        if n == 0:
+            return None, 0
+        x = jax.device_put(jnp.asarray(x), state["device"])
+        return state["forward"](state["params"], x), n
+
+    def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
+        out, n = handle
         if n == 0:
             return {
                 self.feature_type: np.zeros((0, VGGISH_EMBEDDING_DIM), np.float32)
             }
-        x = jax.device_put(jnp.asarray(x), state["device"])
-        feats = np.asarray(state["forward"](state["params"], x))[:n]
-        return {self.feature_type: feats}
+        return {self.feature_type: np.asarray(out)[:n]}
