@@ -209,8 +209,47 @@ def bench_flash_attention() -> dict:
     }
 
 
+def _probe_backend(timeout_s: float = 180.0) -> None:
+    """Fail fast if the TPU backend is unreachable. The axon tunnel's
+    compile helper can die (observed 2026-07-30), after which
+    jax.devices() blocks FOREVER — without this guard the whole bench
+    hangs instead of reporting an actionable error."""
+    import threading
+
+    devices: list = []
+    errors: list = []
+
+    def probe():
+        try:
+            import jax
+
+            devices.extend(jax.devices())
+        except Exception as e:  # noqa: BLE001 - reported below
+            errors.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive() or errors or not devices:
+        reason = (
+            f"did not return within {timeout_s:.0f}s"
+            if t.is_alive()
+            else (f"raised {errors[0]!r}" if errors else "returned no devices")
+        )
+        print(
+            f"FATAL: jax.devices() {reason} — the TPU backend/tunnel is "
+            "unreachable (dead compile helper?). No benchmark numbers were "
+            "produced.",
+            file=sys.stderr,
+        )
+        os._exit(3)
+    print(f"backend ok: {devices}", file=sys.stderr)
+
+
 def main() -> None:
     from video_features_tpu.utils.synth import synth_video
+
+    _probe_backend()
 
     n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
     baselines = _load_measured_baselines()

@@ -330,6 +330,23 @@ class ExtractI3D(BaseExtractor):
     # decode moves into the dispatch phase (one video at a time — the old
     # serial memory profile), same pattern as ResNet's streaming fallback.
     PIPELINE_MAX_FRAMES = 4096
+    # bytes one resized frame costs — the budget unit the cap counts in
+    # (min-side 256, ~4:3; disk-flow images are converted to this unit
+    # because they prefetch at ORIGINAL resolution)
+    _FRAME_BYTES = 256 * 342 * 3 * 4
+
+    def _flow_prefetch_cost(self, flow_dir: str) -> int:
+        """Disk-flow resident cost in resized-frame equivalents: flow
+        JPEGs stay full-resolution until the device transform, so a 1080p
+        flow dir can dwarf the frames the cap was sized for."""
+        pairs = self._load_flow_pairs(flow_dir)
+        if not pairs:
+            return 0
+        first = cv2.imread(str(pairs[0][0]), cv2.IMREAD_GRAYSCALE)
+        if first is None:  # unreadable: let _read_flow_images raise later
+            return 0
+        per_pair = first.shape[0] * first.shape[1] * 2 * 4
+        return len(pairs) * per_pair // self._FRAME_BYTES
 
     def _decode_resized(self, video_path, meta=None):
         frames, fps, timestamps_ms = self._sample_frames(video_path, meta)
@@ -351,19 +368,22 @@ class ExtractI3D(BaseExtractor):
             )
         video_path = video_path_of(path_entry)
         meta = probe(video_path, self.config.decoder)
-        if self._sampled_count(meta) > self.PIPELINE_MAX_FRAMES:
+        cost = self._sampled_count(meta)
+        if from_disk:
+            cost += self._flow_prefetch_cost(path_entry[1])
+        if cost > self.PIPELINE_MAX_FRAMES:
             # too big to prefetch whole: frames AND disk flow defer to the
             # dispatch phase (one over-cap video resident at a time)
-            return None, None, from_disk
+            return None, None, from_disk, meta
         flow_imgs = self._read_flow_images(path_entry[1]) if from_disk else None
-        return self._decode_resized(video_path, meta), flow_imgs, from_disk
+        return self._decode_resized(video_path, meta), flow_imgs, from_disk, meta
 
     def dispatch_prepared(self, device, state, path_entry, payload):
-        decoded, flow_imgs, from_disk = payload
+        decoded, flow_imgs, from_disk, meta = payload
         if decoded is None:  # over the prefetch cap: load here, held once
             if from_disk:
                 flow_imgs = self._read_flow_images(path_entry[1])
-            decoded = self._decode_resized(video_path_of(path_entry))
+            decoded = self._decode_resized(video_path_of(path_entry), meta)
         frames, fps, timestamps_ms = decoded
         fns = self._fns_for_shape(state, frames[0].shape[:2])
 
