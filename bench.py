@@ -216,6 +216,12 @@ def _probe_backend(timeout_s: float = 180.0) -> None:
     hangs instead of reporting an actionable error."""
     import threading
 
+    from video_features_tpu.parallel.devices import pin_platform
+
+    # honor JAX_PLATFORMS (the axon discovery hook ignores the env var —
+    # a cpu-pinned bench run must not dial the chip tunnel)
+    pin_platform()
+
     devices: list = []
     errors: list = []
 

@@ -13,6 +13,10 @@ Usage:
       resnet50-0676ba61.pth resnet50.msgpack
   python scripts/convert_weights.py --feature_type i3d \
       i3d_flow.pt i3d_flow.msgpack
+  # orbax checkpoint dir (sharded; mesh/multi-host runs restore each
+  # weight directly onto its devices):
+  python scripts/convert_weights.py --feature_type CLIP-ViT-B/32 \
+      ViT-B-32.pt ./weights/clip_b32_orbax
 """
 
 from __future__ import annotations
@@ -68,26 +72,46 @@ def convert_fn(feature_type: str):
 
 def main() -> None:
     from video_features_tpu.config import FEATURE_TYPES
+    from video_features_tpu.parallel.devices import pin_platform
+
+    # conversion is pure host work — never dial a TPU backend for it
+    pin_platform("cpu")
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--feature_type", required=True, choices=FEATURE_TYPES)
     ap.add_argument("src", help="source checkpoint (.pt/.pth/.pytorch/.bin/.npz)")
-    ap.add_argument("dst", help="output .msgpack path")
+    ap.add_argument(
+        "dst",
+        help="output: a .msgpack file, or (no suffix) an orbax checkpoint "
+        "directory — the sharded format a mesh/multi-host run restores "
+        "directly onto its devices",
+    )
     args = ap.parse_args()
 
-    if not args.dst.endswith(".msgpack"):
-        raise SystemExit(f"dst must end in .msgpack, got {args.dst}")
+    from video_features_tpu.models.common.weights import load_params, save_orbax
 
-    from flax import serialization
-
-    from video_features_tpu.models.common.weights import load_params
+    # validate dst BEFORE the (potentially multi-GB) load+convert
+    as_msgpack = args.dst.endswith(".msgpack")
+    if not as_msgpack:
+        if args.dst.endswith((".npz", ".pt", ".pth", ".pytorch", ".bin")):
+            raise SystemExit(
+                f"dst must be .msgpack or an orbax directory (no file "
+                f"suffix), got {args.dst}"
+            )
+        if os.path.exists(args.dst):
+            raise SystemExit(f"orbax dst already exists: {args.dst}")
 
     params = load_params(args.src, convert_fn(args.feature_type))
-    blob = serialization.msgpack_serialize(params)
-    tmp = args.dst + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, args.dst)
+    if as_msgpack:
+        from flax import serialization
+
+        blob = serialization.msgpack_serialize(params)
+        tmp = args.dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, args.dst)
+    else:
+        save_orbax(params, args.dst)
     import jax
 
     n = sum(x.size for x in jax.tree.leaves(params))

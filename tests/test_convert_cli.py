@@ -52,8 +52,12 @@ def test_convert_cli_resnet_roundtrip(tmp_path, capsys):
     np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
 
 
-def test_convert_cli_rejects_non_msgpack_dst(tmp_path):
+def test_convert_cli_rejects_checkpoint_suffix_dst(tmp_path):
+    """A dst that looks like a source-format file is a user mistake; only
+    .msgpack files and orbax directories (no file suffix) are outputs."""
+    from tests.test_resnet import _torch_oracle
+
     src = tmp_path / "w.pt"
-    torch.save({}, src)
-    with pytest.raises(SystemExit, match="msgpack"):
+    torch.save(_torch_oracle("resnet18").state_dict(), src)
+    with pytest.raises(SystemExit, match="msgpack or an orbax"):
         _run_cli(["--feature_type", "resnet18", str(src), str(tmp_path / "o.npz")])

@@ -93,11 +93,18 @@ class ExtractCLIP(BaseExtractor):
         else:
             attn_core = None
         model = VisionTransformer(self.model_cfg, dtype=dt, attn_core=attn_core)
-        params = self._load_host_params()
-        if dt != jnp.float32:
-            # final projection stays fp32 (the 512-d embedding contract)
-            params = cast_floats_for_compute(params, dt, exclude=("proj",))
+        from video_features_tpu.models.common.weights import (
+            is_orbax_checkpoint,
+            load_orbax,
+        )
 
+        def cast(params):
+            if dt != jnp.float32:
+                # final projection stays fp32 (the 512-d embedding contract)
+                return cast_floats_for_compute(params, dt, exclude=("proj",))
+            return params
+
+        wp = self.config.weights_path
         if is_mesh(device):
             # one GSPMD-sharded executable: TP over attention/MLP weights,
             # plus either DP over the frame batch (default) or context
@@ -106,13 +113,22 @@ class ExtractCLIP(BaseExtractor):
             # and the token axis shards inside the model)
             from jax.sharding import PartitionSpec as P
 
-            params = place_params(params, device, clip_vit_param_specs)
+            if wp and is_orbax_checkpoint(wp):
+                # orbax + mesh: restore each weight DIRECTLY onto its
+                # destination devices under the TP specs — no full-tree
+                # host copy (multi-host-safe: each process reads only its
+                # shards), then cast in place for --dtype
+                params = cast(load_orbax(wp, device, clip_vit_param_specs))
+            else:
+                params = place_params(
+                    cast(self._load_host_params()), device, clip_vit_param_specs
+                )
             spec = P() if context else P("data")
             encode_image = build_sharded_apply(
                 model, device, batch_spec=spec, out_spec=spec
             )
         else:
-            params = jax.device_put(params, device)
+            params = jax.device_put(cast(self._load_host_params()), device)
 
             @jax.jit
             def encode_image(p, x):

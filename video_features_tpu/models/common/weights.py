@@ -48,14 +48,72 @@ def load_state_dict(path: str) -> Dict[str, np.ndarray]:
     return out
 
 
+def is_orbax_checkpoint(path: str) -> bool:
+    """An orbax checkpoint directory (written by ``save_orbax`` /
+    scripts/convert_weights.py) — distinguished from plain weight dirs
+    (e.g. I3D's directory of reference-named .pt files) by its marker."""
+    return os.path.isdir(path) and (
+        os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))
+        or os.path.exists(os.path.join(path, "_METADATA"))
+    )
+
+
+def save_orbax(params: Any, path: str) -> None:
+    """Write a converted param tree as an orbax checkpoint directory —
+    the sharded-checkpoint format: each array is chunked on disk, so a
+    mesh/multi-host run can restore every weight DIRECTLY onto its
+    destination devices (``load_orbax`` with a mesh) without ever
+    materializing the full tree in one host's memory. The TPU-native
+    upgrade of the reference's whole-file torch pickles (SURVEY.md §2
+    #21)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params)
+    ckptr.wait_until_finished()
+
+
+def load_orbax(path: str, mesh=None, specs_fn=None) -> Any:
+    """Restore an orbax checkpoint.
+
+    ``mesh=None``: host numpy tree (the ``load_params`` path).
+    With a ``jax.sharding.Mesh``: build the abstract target from the
+    checkpoint's own metadata and restore each leaf already placed under
+    ``specs_fn(meta_tree) -> PartitionSpec tree`` (None = replicate) —
+    no full-tree host copy, shards stream to their devices.
+    """
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    path = os.path.abspath(path)
+    if mesh is None:
+        return ckptr.restore(path)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    meta = ckptr.metadata(path).item_metadata
+    specs = specs_fn(meta) if specs_fn else jax.tree.map(lambda _: P(), meta)
+    target = jax.tree.map(
+        lambda m, s: jax.ShapeDtypeStruct(
+            m.shape, m.dtype, sharding=NamedSharding(mesh, s)
+        ),
+        meta,
+        specs,
+    )
+    return ckptr.restore(path, target)
+
+
 def load_params(path: str, convert) -> Any:
     """Load model params for an extractor.
 
     ``.msgpack`` holds an already-converted flax param tree (saved with
-    ``flax.serialization.msgpack_serialize``) and is returned as-is;
-    anything else is a source-framework state dict that goes through
-    ``load_state_dict`` + the family's ``convert`` function.
+    ``flax.serialization.msgpack_serialize``) and an orbax checkpoint
+    directory an already-converted sharded tree — both are returned
+    as-is; anything else is a source-framework state dict that goes
+    through ``load_state_dict`` + the family's ``convert`` function.
     """
+    if is_orbax_checkpoint(path):
+        return load_orbax(path)
     if path.endswith(".msgpack"):
         if not os.path.exists(path):
             raise FileNotFoundError(f"weights not found: {path}")

@@ -294,11 +294,13 @@ class ExtractI3D(BaseExtractor):
                 raise ValueError(f"flow pair mismatch: {x.name} vs {y.name}")
         return list(zip(xs, ys))
 
-    def _read_flow_images(self, flow_dir: str) -> np.ndarray:
+    def _read_flow_images(self, flow_dir: str, pairs=None) -> np.ndarray:
         """Decode every flow JPEG pair ONCE -> (N, H, W, 2) float32 (the
         windows may overlap when step < stack; re-decoding per window
-        would repeat the disk reads)."""
-        pairs = self._load_flow_pairs(flow_dir)
+        would repeat the disk reads). ``pairs`` reuses a prior
+        ``_load_flow_pairs`` scan."""
+        if pairs is None:
+            pairs = self._load_flow_pairs(flow_dir)
         imgs = np.stack(
             [
                 np.stack(
@@ -335,18 +337,22 @@ class ExtractI3D(BaseExtractor):
     # because they prefetch at ORIGINAL resolution)
     _FRAME_BYTES = 256 * 342 * 3 * 4
 
-    def _flow_prefetch_cost(self, flow_dir: str) -> int:
+    def _flow_prefetch_cost(self, pairs) -> int:
         """Disk-flow resident cost in resized-frame equivalents: flow
         JPEGs stay full-resolution until the device transform, so a 1080p
-        flow dir can dwarf the frames the cap was sized for."""
-        pairs = self._load_flow_pairs(flow_dir)
+        flow dir can dwarf the frames the cap was sized for. ``pairs`` is
+        the caller's already-scanned ``_load_flow_pairs`` result; PIL
+        reads only the first image's header for the size."""
         if not pairs:
             return 0
-        first = cv2.imread(str(pairs[0][0]), cv2.IMREAD_GRAYSCALE)
-        if first is None:  # unreadable: let _read_flow_images raise later
+        from PIL import Image
+
+        try:
+            with Image.open(pairs[0][0]) as im:
+                w, h = im.size
+        except OSError:  # unreadable: let _read_flow_images raise later
             return 0
-        per_pair = first.shape[0] * first.shape[1] * 2 * 4
-        return len(pairs) * per_pair // self._FRAME_BYTES
+        return len(pairs) * (h * w * 2 * 4) // self._FRAME_BYTES
 
     def _decode_resized(self, video_path, meta=None):
         frames, fps, timestamps_ms = self._sample_frames(video_path, meta)
@@ -369,13 +375,16 @@ class ExtractI3D(BaseExtractor):
         video_path = video_path_of(path_entry)
         meta = probe(video_path, self.config.decoder)
         cost = self._sampled_count(meta)
+        pairs = self._load_flow_pairs(path_entry[1]) if from_disk else None
         if from_disk:
-            cost += self._flow_prefetch_cost(path_entry[1])
+            cost += self._flow_prefetch_cost(pairs)
         if cost > self.PIPELINE_MAX_FRAMES:
             # too big to prefetch whole: frames AND disk flow defer to the
             # dispatch phase (one over-cap video resident at a time)
             return None, None, from_disk, meta
-        flow_imgs = self._read_flow_images(path_entry[1]) if from_disk else None
+        flow_imgs = (
+            self._read_flow_images(path_entry[1], pairs) if from_disk else None
+        )
         return self._decode_resized(video_path, meta), flow_imgs, from_disk, meta
 
     def dispatch_prepared(self, device, state, path_entry, payload):
