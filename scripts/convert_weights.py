@@ -93,7 +93,9 @@ def main() -> None:
     # validate dst BEFORE the (potentially multi-GB) load+convert
     as_msgpack = args.dst.endswith(".msgpack")
     if not as_msgpack:
-        if args.dst.endswith((".npz", ".pt", ".pth", ".pytorch", ".bin")):
+        # allowlist: an orbax dst is a DIRECTORY name — any file-like
+        # suffix (.msgpak typo, .ckpt, .npz, ...) is a user mistake
+        if os.path.splitext(os.path.basename(args.dst))[1]:
             raise SystemExit(
                 f"dst must be .msgpack or an orbax directory (no file "
                 f"suffix), got {args.dst}"

@@ -65,7 +65,7 @@ def test_convert_cli_orbax_roundtrip(tmp_path, capsys):
     )
 
 
-def test_load_orbax_sharded_restore_places_leaves():
+def test_load_orbax_sharded_restore_places_leaves(tmp_path):
     """Restore-with-mesh places every leaf under the requested specs
     (metadata-driven abstract target, no host tree)."""
     from video_features_tpu.models.clip.model import CLIPVisionConfig, init_params
@@ -75,9 +75,7 @@ def test_load_orbax_sharded_restore_places_leaves():
         patch_size=16, width=64, layers=2, heads=4, embed_dim=32, image_size=32
     )
     params = init_params(cfg)
-    import tempfile, os
-
-    path = os.path.join(tempfile.mkdtemp(), "clip_ck")
+    path = str(tmp_path / "clip_ck")
     save_orbax(params, path)
     mesh = make_mesh(jax.devices(), data=4, model=2)
     sharded = load_orbax(path, mesh, clip_vit_param_specs)

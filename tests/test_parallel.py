@@ -270,8 +270,14 @@ def test_mesh_context_rejects_non_transformer(sample_video, tmp_path):
 
 
 def test_mesh_rejects_unsupported_feature_type(sample_video, tmp_path):
+    """Every shipped extractor is mesh-capable now, so the refusal path is
+    exercised through a non-capable stand-in (it still guards any future
+    extractor that forgets to declare support)."""
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.parallel.scheduler import mesh_feature_extraction
+
+    class NoMesh(ExtractI3D):
+        mesh_capable = False
 
     cfg = ExtractionConfig(
         allow_random_init=True,
@@ -280,7 +286,7 @@ def test_mesh_rejects_unsupported_feature_type(sample_video, tmp_path):
         tmp_path=str(tmp_path / "t"),
         output_path=str(tmp_path / "o"),
     )
-    ex = ExtractI3D(cfg)
+    ex = NoMesh(cfg)
     ex.progress.disable = True
     with pytest.raises(ValueError, match="sharding mesh"):
         mesh_feature_extraction(ex, jax.devices())
@@ -428,3 +434,38 @@ def test_device_pipeline_isolates_corrupt_video(sample_video, tmp_path):
     np.testing.assert_array_equal(
         results[0]["CLIP-ViT-B/32"], results[1]["CLIP-ViT-B/32"]
     )
+
+
+def test_mesh_i3d_sequence_parallel_matches_single_device(sample_video, tmp_path):
+    """I3D mesh mode: the stack's frame axis shards over 'data' inside
+    the fused per-stream pipelines — for the rgb stream that is I3D's own
+    temporal convs/pools resharding with GSPMD halos. Matches the
+    single-device run to reduction-order tolerance (uneven 11-frame
+    shards repartition the conv reductions). The flow streams' pair-view
+    halos are covered by test_mesh_raft_sequence_parallel... (same
+    mechanism, and the PWC double-compile here would dominate CI)."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="i3d",
+        flow_type="pwc",
+        streams=["rgb"],
+        video_paths=[sample_video],
+        stack_size=10,
+        step_size=24,
+        tmp_path=str(tmp_path / "t"),
+        output_path=str(tmp_path / "o"),
+    )
+
+    def run(device):
+        ex = ExtractI3D(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0], device=device)[0]
+
+    single = run(jax.devices()[0])
+    mesh = make_mesh(jax.devices(), model=1)
+    sharded = run(mesh)
+    assert single["rgb"].shape == sharded["rgb"].shape == (3, 1024)
+    np.testing.assert_allclose(sharded["rgb"], single["rgb"], atol=2e-4)

@@ -158,3 +158,32 @@ def test_extract_vggish_end_to_end(sample_wav, tmp_path):
     assert feats.shape == (3, 128)
     assert np.isfinite(feats).all()
     assert (feats >= 0).all()  # final ReLU
+
+
+def test_vggish_mesh_matches_single_device(sample_wav, tmp_path):
+    """--sharding mesh (pure DP over the example batch) matches the
+    single-device run. Not byte-compared: the mesh pads the batch to a
+    data-divisible row count, and a different batch shape reassociates
+    XLA's conv reductions at the ulp level."""
+    import jax
+
+    from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="vggish",
+        video_paths=[sample_wav],
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+    )
+
+    def run(device):
+        ex = ExtractVGGish(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0], device=device)[0]["vggish"]
+
+    single = run(jax.devices()[0])
+    mesh = make_mesh(jax.devices(), model=1)
+    np.testing.assert_allclose(run(mesh), single, atol=1e-5)
+    assert single.shape == (3, 128)

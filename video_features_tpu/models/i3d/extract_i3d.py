@@ -79,6 +79,12 @@ def center_crop(x: jnp.ndarray, crop: int = CENTRAL_CROP_SIZE) -> jnp.ndarray:
 
 
 class ExtractI3D(BaseExtractor):
+    # --sharding mesh: each stack's FRAME axis shards over 'data' inside
+    # the jitted per-stream pipelines (sequence parallelism: GSPMD halo
+    # exchanges for RAFT/PWC pair views and I3D's temporal convs);
+    # weights replicate
+    mesh_capable = True
+
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)
         self.streams = list(self.config.streams or ["rgb", "flow"])
@@ -152,6 +158,8 @@ class ExtractI3D(BaseExtractor):
             compute_dtype,
         )
 
+        from video_features_tpu.parallel.sharding import place_params
+
         dt = compute_dtype(self.config)
         state = {"device": device, "params": {}, "fns": {}, "dtype": dt}
         for stream in self.streams:
@@ -161,20 +169,41 @@ class ExtractI3D(BaseExtractor):
                 # nets below stay fp32 — their iterative refinement is the
                 # parity-critical path (VERDICT r1 #4 "correlation fp32")
                 p = cast_floats_for_compute(p, dt, exclude=("conv3d_0c_1x1",))
-            state["params"][stream] = jax.device_put(p, device)
+            state["params"][stream] = place_params(p, device)
         if "flow" in self.streams and self.flow_type in ("raft", "pwc"):
-            state["params"][self.flow_type] = jax.device_put(
+            state["params"][self.flow_type] = place_params(
                 self._params(self.flow_type), device
             )
         return state
 
     def _fns_for_shape(self, state, shape):
-        """Jitted per-stream pipelines for one (H, W) frame shape."""
+        """Jitted per-stream pipelines for one (H, W) frame shape.
+
+        On a Mesh, the stack's FRAME axis shards over 'data' (the same
+        sequence parallelism as the standalone flow extractors): GSPMD
+        inserts the pair-view halo exchange for RAFT/PWC and the
+        temporal-conv halos for I3D itself; weights replicate. The
+        constraint is applied inside jit, so uneven stack lengths (11..65
+        frames) need no host-side padding."""
+        from video_features_tpu.parallel.sharding import is_mesh
+
         key = tuple(shape)
         if key in state["fns"]:
             return state["fns"][key]
         i3d = i3d_build(dtype=state.get("dtype", jnp.float32))
         fns = {}
+
+        if is_mesh(state["device"]):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            seq = NamedSharding(state["device"], P("data"))
+
+            def shard_seq(stack):
+                return jax.lax.with_sharding_constraint(stack, seq)
+        else:
+
+            def shard_seq(stack):
+                return stack
 
         if "rgb" in self.streams:
 
@@ -183,7 +212,7 @@ class ExtractI3D(BaseExtractor):
                 # stack[:-1] in EVERY mode: with pre-extracted flow the
                 # window is stack_size, so rgb runs on stack_size-1 frames
                 # — exactly the reference (extract_i3d.py:178-179,221-222)
-                x = scale_to_1_1(center_crop(stack[:-1]))
+                x = scale_to_1_1(center_crop(shard_seq(stack)[:-1]))
                 return i3d.apply({"params": p}, x[None])
 
             fns["rgb"] = rgb_fn
@@ -199,7 +228,8 @@ class ExtractI3D(BaseExtractor):
             @jax.jit
             def flow_fn(p_flow, p_i3d, stack):
                 padded = jnp.pad(
-                    stack, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge"
+                    shard_seq(stack), ((0, 0), (t, b), (l, r), (0, 0)),
+                    mode="edge",
                 )
                 flow = raft.apply({"params": p_flow}, padded)  # (S, Hp, Wp, 2)
                 # the reference crops the PADDED flow (extract_i3d.py:170-184)
@@ -214,7 +244,7 @@ class ExtractI3D(BaseExtractor):
 
             @jax.jit
             def flow_fn(p_flow, p_i3d, stack):
-                flow = pwc.apply({"params": p_flow}, stack)  # (S, H, W, 2)
+                flow = pwc.apply({"params": p_flow}, shard_seq(stack))  # (S, H, W, 2)
                 f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
                 return i3d.apply({"params": p_i3d}, f[None])
 
@@ -231,7 +261,7 @@ class ExtractI3D(BaseExtractor):
                 # pixels (extract_i3d.py:204-220), collapsing nearly every
                 # value to 255 — its flow-from-disk features are garbage,
                 # and no round-trip with its own flow extractors can work.
-                f = scale_to_1_1(center_crop(flow_imgs))
+                f = scale_to_1_1(center_crop(shard_seq(flow_imgs)))
                 return i3d.apply({"params": p_i3d}, f[None])
 
             fns["flow"] = flow_fn
@@ -388,6 +418,10 @@ class ExtractI3D(BaseExtractor):
         return self._decode_resized(video_path, meta), flow_imgs, from_disk, meta
 
     def dispatch_prepared(self, device, state, path_entry, payload):
+        from jax.sharding import PartitionSpec as P
+
+        from video_features_tpu.parallel.sharding import place_batch
+
         decoded, flow_imgs, from_disk, meta = payload
         if decoded is None:  # over the prefetch cap: load here, held once
             if from_disk:
@@ -407,7 +441,7 @@ class ExtractI3D(BaseExtractor):
             form_slices(extent, window, self.step_size)
         ):
             stack = np.stack(frames[start:end])
-            x = jax.device_put(jnp.asarray(stack), state["device"])
+            x = place_batch(stack, state["device"], spec=P())
             outs = []
             for stream in self.streams:
                 if stream == "rgb":
@@ -415,7 +449,9 @@ class ExtractI3D(BaseExtractor):
                 elif from_disk:
                     f, logits = fns["flow"](
                         state["params"]["flow"],
-                        jax.device_put(jnp.asarray(flow_imgs[start:end]), state["device"]),
+                        place_batch(
+                            flow_imgs[start:end], state["device"], spec=P()
+                        ),
                     )
                 else:
                     f, logits = fns["flow"](

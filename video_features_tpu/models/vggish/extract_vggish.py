@@ -36,6 +36,10 @@ from video_features_tpu.ops.window import bucket_size, pad_batch
 
 
 class ExtractVGGish(BaseExtractor):
+    # --sharding mesh: the 0.96 s example batch shards over 'data'
+    # (pure DP; the VGG weights replicate — tiny next to activations)
+    mesh_capable = True
+
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)
         self._host_params = None
@@ -56,13 +60,16 @@ class ExtractVGGish(BaseExtractor):
         return self._host_params
 
     def _build(self, device):
+        from video_features_tpu.parallel.sharding import (
+            jit_sharded_forward,
+            place_params,
+        )
+
         model = build()
-        params = jax.device_put(self._load_host_params(), device)
-
-        @jax.jit
-        def forward(p, x):  # (B, 96, 64, 1)
-            return model.apply({"params": p}, x)
-
+        params = place_params(self._load_host_params(), device)
+        forward = jit_sharded_forward(
+            lambda p, x: model.apply({"params": p}, x), device  # (B, 96, 64, 1)
+        )
         return {"params": params, "forward": forward, "device": device}
 
     # host half: wav rip + NumPy log-mel frontend (runs on
@@ -84,10 +91,12 @@ class ExtractVGGish(BaseExtractor):
     # device half, split for the device pipeline (extract/base.py):
     # transfer + async jitted VGG forward at dispatch, fetch later
     def dispatch_prepared(self, device, state, path_entry, payload):
+        from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
         x, n = payload
         if n == 0:
             return None, 0
-        x = jax.device_put(jnp.asarray(x), state["device"])
+        x = place_batch(pad_batch_for(state["device"], x), state["device"])
         return state["forward"](state["params"], x), n
 
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
