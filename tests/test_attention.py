@@ -132,6 +132,27 @@ def test_ring_attention_inside_gspmd_jit_sharded_io():
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("block", [4, 16])
+def test_ring_attention_blockwise_shards_match_fused(block):
+    """block_size chunks each arriving KV shard through the blockwise
+    accumulator (ring x flash composition) — still exact, including with
+    a padded+masked token axis."""
+    mesh = make_mesh(jax.devices()[:8], data=8, model=1)
+    q, k, v = _qkv(np.random.default_rng(11), lq=50, lk=50, d=16)
+    ref = attention(q, k, v)
+    pad = ((0, 0), (0, 0), (0, 6), (0, 0))
+    qp, kp, vp = (jnp.pad(t, pad) for t in (q, k, v))
+
+    @jax.jit
+    def fn(q, k, v):
+        return ring_attention_sharded(
+            q, k, v, mesh, axis_name="data", kv_len=50, block_size=block
+        )
+
+    out = fn(qp, kp, vp)[:, :, :50]
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_ring_attention_single_shard_axis():
     mesh = make_mesh(jax.devices()[:2], data=1, model=2)
     q, k, v = _qkv(np.random.default_rng(8), lq=8, lk=8)
@@ -147,15 +168,17 @@ def test_ring_attention_rejects_indivisible_tokens():
         ring_attention_sharded(q, k, v, mesh, axis_name="data")
 
 
-def test_context_parallel_core_pads_and_masks():
+@pytest.mark.parametrize("block", [None, 8])
+def test_context_parallel_core_pads_and_masks(block):
     """make_context_parallel_core handles the ViT's ragged token axis
-    (grid*grid+1) transparently — same answer as fused attention."""
+    (grid*grid+1) transparently — same answer as fused attention — with
+    and without per-shard blockwise chunking."""
     from video_features_tpu.parallel.ring_attention import (
         make_context_parallel_core,
     )
 
     mesh = make_mesh(jax.devices()[:8], data=4, model=2)
-    core = make_context_parallel_core(mesh)
+    core = make_context_parallel_core(mesh, block_size=block)
     # 50 tokens (B/32 grid), 4 heads over model=2
     q, k, v = _qkv(np.random.default_rng(10), h=4, lq=50, lk=50, d=16)
     ref = attention(q, k, v)

@@ -105,6 +105,52 @@ def init_carry(q: jnp.ndarray):
     return m, l, acc
 
 
+def accumulate_blockwise(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    carry,
+    scale: float,
+    block_size: int,
+    offset=0,
+    limit: Optional[jnp.ndarray] = None,
+):
+    """Fold ``k``/``v`` into an online-softmax ``(m, l, acc)`` carry in
+    ``block_size`` chunks. Positions are ``offset + i`` globally; those
+    ``>= limit`` are masked (None = only the divisibility padding added
+    here is masked). Shared by ``blockwise_attention`` (one local scan)
+    and ring attention (one call per arriving KV shard)."""
+    N, H, Lk, d = k.shape
+    # a span shorter than the block must not pad UP to it — that would
+    # burn masked FLOPs every call (ring hops call this per shard)
+    block_size = min(block_size, Lk)
+    nb = -(-Lk // block_size)
+    pad = nb * block_size - Lk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # the divisibility padding is ALWAYS masked: even when the caller's
+    # global limit lies beyond this span (a ring shard mid-sequence),
+    # positions past offset+Lk are fabricated here, not real tokens
+    end = offset + Lk
+    limit = jnp.asarray(end if limit is None else jnp.minimum(limit, end))
+    kb = k.reshape(N, H, nb, block_size, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(N, H, nb, block_size, d).transpose(2, 0, 1, 3, 4)
+    offs = offset + jnp.arange(nb) * block_size
+
+    def step(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, off = blk
+        mask = (off + jnp.arange(block_size)) < limit
+        m, l, acc = online_softmax_step(
+            q, k_blk, v_blk, m, l, acc, scale, kv_mask=mask[None, None, None, :]
+        )
+        return (m, l, acc), None
+
+    carry, _ = lax.scan(step, carry, (kb, vb, offs))
+    return carry
+
+
 def blockwise_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -118,26 +164,8 @@ def blockwise_attention(
     composing with the caller's own ``kv_len`` mask), then scanned with
     ``online_softmax_step``. Peak live score memory is O(Lq * block_size).
     """
-    N, H, Lk, d = k.shape
     scale = q.shape[-1] ** -0.5
-    nb = -(-Lk // block_size)
-    pad = nb * block_size - Lk
-    if pad:
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-    limit = jnp.asarray(Lk if kv_len is None else kv_len)
-    kb = k.reshape(N, H, nb, block_size, d).transpose(2, 0, 1, 3, 4)
-    vb = v.reshape(N, H, nb, block_size, d).transpose(2, 0, 1, 3, 4)
-    offs = jnp.arange(nb) * block_size
-
-    def step(carry, blk):
-        m, l, acc = carry
-        k_blk, v_blk, off = blk
-        mask = (off + jnp.arange(block_size)) < limit
-        m, l, acc = online_softmax_step(
-            q, k_blk, v_blk, m, l, acc, scale, kv_mask=mask[None, None, None, :]
-        )
-        return (m, l, acc), None
-
-    (m, l, acc), _ = lax.scan(step, init_carry(q), (kb, vb, offs))
+    m, l, acc = accumulate_blockwise(
+        q, k, v, init_carry(q), scale, block_size, limit=kv_len
+    )
     return _finalize(m, l, acc, q.dtype)

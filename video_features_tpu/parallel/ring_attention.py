@@ -36,7 +36,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import _finalize, init_carry, online_softmax_step
+from ..ops.attention import (
+    _finalize,
+    accumulate_blockwise,
+    init_carry,
+    online_softmax_step,
+)
 
 
 def ring_attention(
@@ -45,6 +50,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     kv_len: Optional[jnp.ndarray] = None,
+    block_size: Optional[int] = None,
 ) -> jnp.ndarray:
     """Per-shard ring attention; must run under shard_map/pmap.
 
@@ -52,6 +58,11 @@ def ring_attention(
     L-sharded tensors. ``kv_len`` is the *global* number of valid tokens
     (None = every position valid). Returns this chip's (N, H, L_local, d)
     output shard.
+
+    ``block_size`` additionally chunks each arriving KV shard through the
+    blockwise accumulator — the fully-composed long-context core: live
+    score memory O(Lq_local * block_size) even when one chip's shard is
+    itself too long for a single score matrix.
     """
     axis_size = lax.axis_size(axis_name)
     axis_index = lax.axis_index(axis_name)
@@ -65,14 +76,20 @@ def ring_attention(
         # k_cur/v_cur started on chip (axis_index - hop): their global
         # token offset is that source chip's shard offset.
         src = (axis_index - hop) % axis_size
-        if limit is None:
-            kv_mask = None
+        if block_size is not None:
+            m, l, acc = accumulate_blockwise(
+                q, k_cur, v_cur, (m, l, acc), scale, block_size,
+                offset=src * l_local, limit=limit,
+            )
         else:
-            pos = src * l_local + jnp.arange(l_local)
-            kv_mask = (pos < limit)[None, None, None, :]
-        m, l, acc = online_softmax_step(
-            q, k_cur, v_cur, m, l, acc, scale, kv_mask=kv_mask
-        )
+            if limit is None:
+                kv_mask = None
+            else:
+                pos = src * l_local + jnp.arange(l_local)
+                kv_mask = (pos < limit)[None, None, None, :]
+            m, l, acc = online_softmax_step(
+                q, k_cur, v_cur, m, l, acc, scale, kv_mask=kv_mask
+            )
         # Rotate KV shards one hop around the ring (ICI neighbor exchange).
         # scan needs a uniform carry, so the final hop also permutes; that
         # last exchange restores the original shard placement.
@@ -95,6 +112,7 @@ def ring_attention_sharded(
     axis_name: str = "data",
     kv_len: Optional[jnp.ndarray] = None,
     head_axis: Optional[str] = None,
+    block_size: Optional[int] = None,
 ) -> jnp.ndarray:
     """Global-view ring attention: shard_map over ``mesh[axis_name]``.
 
@@ -121,7 +139,8 @@ def ring_attention_sharded(
         )
     spec = P(None, head_axis, axis_name, None)
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, kv_len=kv_len),
+        partial(ring_attention, axis_name=axis_name, kv_len=kv_len,
+                block_size=block_size),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -131,7 +150,8 @@ def ring_attention_sharded(
 
 
 def make_context_parallel_core(
-    mesh: Mesh, axis_name: str = "data", head_axis: Optional[str] = "model"
+    mesh: Mesh, axis_name: str = "data", head_axis: Optional[str] = "model",
+    block_size: Optional[int] = None,
 ):
     """An ``attn_core(q, k, v) -> out`` for transformer models running in
     ``--sharding mesh --mesh_context`` mode (models/clip/model.py).
@@ -141,6 +161,11 @@ def make_context_parallel_core(
     right-padded to the next multiple, the pad KV positions are masked out
     of the softmax via ``kv_len``, and the pad query rows are sliced off
     the result. ``head_axis`` entries absent from the mesh are ignored.
+
+    ``block_size`` chunks each arriving KV shard through the blockwise
+    accumulator (ring x flash). CLIP's builder leaves it None — 50/197
+    tokens fit one score matrix per hop — but models with long token
+    axes pass it to bound live-score memory at O(Lq_local * block).
     """
     if head_axis is not None and head_axis not in mesh.shape:
         head_axis = None
@@ -157,6 +182,7 @@ def make_context_parallel_core(
         out = ring_attention_sharded(
             q_p, k_p, v_p, mesh, axis_name=axis_name,
             kv_len=None if to == L else L, head_axis=head_axis,
+            block_size=block_size,
         )
         return out[:, :, :L]
 
