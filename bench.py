@@ -57,7 +57,29 @@ def _load_measured_baselines() -> dict:
     return MEASURED_BASELINES
 
 
-def bench_clip(n_videos: int, video: str, tmp: str, dtype: str = "float32") -> float:
+def _pass_stats(n_items: int, times: list) -> dict:
+    """videos/s per pass -> {best, median, passes}. Best is the headline
+    (tunnel latency varies minute to minute and only ADDS time — the best
+    pass is the machine's capability); median + the raw passes ship
+    alongside so round-over-round deltas can't be flattered by one lucky
+    pass (VERDICT r02 'What's weak' #7)."""
+    vps = sorted(n_items / t for t in times)
+    mid = len(vps) // 2
+    median = vps[mid] if len(vps) % 2 else 0.5 * (vps[mid - 1] + vps[mid])
+    return {
+        "best": round(vps[-1], 3),
+        "median": round(median, 3),
+        "passes": [round(v, 3) for v in vps],
+    }
+
+
+def bench_clip(
+    n_videos: int,
+    video: str,
+    tmp: str,
+    dtype: str = "float32",
+    video_batch: int = 1,
+) -> dict:
     from video_features_tpu.config import ExtractionConfig
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
     from video_features_tpu.parallel.devices import resolve_devices
@@ -68,25 +90,28 @@ def bench_clip(n_videos: int, video: str, tmp: str, dtype: str = "float32") -> f
         video_paths=[video] * n_videos,
         extract_method="uni_12",
         dtype=dtype,
+        video_batch=video_batch,
         tmp_path=os.path.join(tmp, "t"),
         output_path=os.path.join(tmp, "o"),
     )
     ex = ExtractCLIP(cfg, external_call=True)
     ex.progress.disable = True
     device = resolve_devices(cfg)[0]
-    ex([0], device=device)  # warmup: decode path + XLA compile
-    # best of 3 passes: the axon tunnel's dispatch latency and host-CPU
-    # contention vary minute to minute; the best pass is the machine's
-    # actual capability (BENCH_r02 observed a 3.6x swing between runs)
-    best = float("inf")
+    # warmup: decode path + XLA compile. Two videos (not one: a single
+    # index takes the serial non-pipelined path, which dispatches
+    # per-video shapes) so the aggregated run's partial flush pads to the
+    # full (video_batch*bucket) shape — the same executable the timed
+    # groups use.
+    ex(range(min(2, n_videos)), device=device)
+    times = []
     for _ in range(3):
         t0 = time.perf_counter()
         results = ex(range(n_videos), device=device)
-        best = min(best, time.perf_counter() - t0)
+        times.append(time.perf_counter() - t0)
     assert len(results) == n_videos and all(
         r["CLIP-ViT-B/32"].shape == (12, 512) for r in results
     )
-    return n_videos / best
+    return _pass_stats(n_videos, times)
 
 
 def bench_i3d_raft(video: str, tmp: str) -> float:
@@ -106,13 +131,13 @@ def bench_i3d_raft(video: str, tmp: str) -> float:
     ex.progress.disable = True
     device = resolve_devices(cfg)[0]
     ex([0], device=device)  # warmup: RAFT scan + two I3D towers compile
-    best = float("inf")
-    for _ in range(2):  # best-of-2: tunnel/host variance (see bench_clip)
+    times = []
+    for _ in range(2):  # 2 passes: tunnel/host variance (see _pass_stats)
         t0 = time.perf_counter()
         (r,) = ex([0], device=device)
-        best = min(best, time.perf_counter() - t0)
+        times.append(time.perf_counter() - t0)
     assert r["rgb"].shape[1] == 1024 and r["flow"].shape[1] == 1024
-    return 1.0 / best
+    return _pass_stats(1, times)
 
 
 def bench_pallas_corr() -> dict:
@@ -209,6 +234,87 @@ def bench_flash_attention() -> dict:
     }
 
 
+# v5e peak: 197 TFLOP/s bf16 per chip (the MXU's native dtype; fp32
+# matmuls pass through the MXU slower — both MFU figures below are
+# reported against THIS number so they compare on one scale).
+V5E_BF16_PEAK_FLOPS = 197e12
+
+
+def bench_clip_device_only() -> dict:
+    """Chip-only throughput: a pre-staged 128-image batch through the
+    jit-compiled ViT-B/32 tower, K forwards chained in one scan (no
+    decode, no host transfer, no tunnel dispatch in the timed loop), plus
+    an MFU estimate from XLA's own per-forward FLOP count. This is the
+    'how much of the chip are we using' number VERDICT r02 asked for —
+    end-to-end videos/s conflates host pipeline + tunnel with compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.clip.model import (
+        CONFIGS,
+        VisionTransformer,
+        init_params,
+    )
+    from video_features_tpu.models.common.weights import cast_floats_for_compute
+
+    if jax.default_backend() != "tpu":
+        return {}
+    cfg = CONFIGS["CLIP-ViT-B/32"]
+    B, K = 128, 10
+    host_params = init_params(cfg)
+    x_host = np.random.RandomState(0).randn(B, 3, 224, 224).astype(np.float32)
+    out = {}
+    for tag, dt in (("fp32", jnp.float32), ("bf16", jnp.bfloat16)):
+        model = VisionTransformer(cfg, dtype=dt)
+        params = host_params
+        if dt != jnp.float32:
+            params = cast_floats_for_compute(params, dt, exclude=("proj",))
+        params = jax.device_put(params)
+        x = jax.device_put(jnp.asarray(x_host))
+
+        def forward(p, x, model=model):
+            return model.apply({"params": p}, x)
+
+        # XLA's own FLOP count for one compiled forward (honest numerator:
+        # counts what actually runs, not a hand model)
+        try:
+            ca = jax.jit(forward).lower(params, x).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0)) or None
+        except Exception:  # noqa: BLE001 - cost analysis is best-effort
+            flops = None
+
+        @jax.jit
+        def loop(p, x, forward=forward):
+            def body(carry, _):
+                acc, x = carry
+                o = forward(p, x)
+                return (acc + jnp.sum(o.astype(jnp.float32)), jnp.roll(x, 1, 0)), None
+
+            (acc, _), _ = jax.lax.scan(body, (jnp.float32(0.0), x), None, length=K)
+            return acc
+
+        float(loop(params, x))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(loop(params, x))
+            best = min(best, time.perf_counter() - t0)
+        ips = B * K / best
+        out[f"clip_device_only_ips_{tag}"] = round(ips, 1)
+        # uni_12 equivalent: what end-to-end videos/s would be if the host
+        # pipeline kept the chip fed — the gap to the measured end-to-end
+        # number is the host/tunnel overhead
+        out[f"clip_device_only_vps_{tag}"] = round(ips / 12.0, 2)
+        if flops:
+            out[f"clip_flops_per_image_{tag}"] = round(flops / B / 1e9, 2)  # GFLOP
+            out[f"clip_mfu_{tag}_of_bf16_peak"] = round(
+                ips * flops / B / V5E_BF16_PEAK_FLOPS, 4
+            )
+    return out
+
+
 def _probe_backend(timeout_s: float = 180.0) -> None:
     """Fail fast if the TPU backend is unreachable. The axon tunnel's
     compile helper can die (observed 2026-07-30), after which
@@ -267,14 +373,28 @@ def main() -> None:
         i3d_video = synth_video(
             os.path.join(tmp, "i3d.mp4"), n_frames=140, width=256, height=256
         )
-        clip_vps = bench_clip(n_videos, clip_video, tmp)
+        # headline: --video_batch 8 (cross-video aggregation, the shipped
+        # fast path); the unaggregated r01/r02-comparable number ships in
+        # extra.clip_solo_* alongside
+        agg = bench_clip(n_videos, clip_video, tmp, video_batch=8)
+        clip_vps = agg["best"]
+        extra["clip_agg_median_vps"] = agg["median"]
+        extra["clip_agg_passes"] = agg["passes"]
+        solo = bench_clip(n_videos, clip_video, tmp)
+        extra["clip_solo_vps"] = solo["best"]
+        extra["clip_solo_median_vps"] = solo["median"]
+        extra["clip_solo_passes"] = solo["passes"]
         if os.environ.get("BENCH_BF16") == "1":
             # --dtype bfloat16 variant (opt-in: costs a second XLA compile)
-            extra["clip_bf16_vps"] = round(
-                bench_clip(n_videos, clip_video, tmp, dtype="bfloat16"), 3
-            )
+            extra["clip_bf16_vps"] = bench_clip(
+                n_videos, clip_video, tmp, dtype="bfloat16", video_batch=8
+            )["best"]
         if os.environ.get("BENCH_SKIP_I3D") != "1":
-            extra["i3d_raft_vps"] = round(bench_i3d_raft(i3d_video, tmp), 3)
+            i3d = bench_i3d_raft(i3d_video, tmp)
+            extra["i3d_raft_vps"] = i3d["best"]
+            extra["i3d_raft_median_vps"] = i3d["median"]
+            extra["i3d_raft_passes"] = i3d["passes"]
+        extra.update(bench_clip_device_only())
         extra.update(bench_pallas_corr())
         if os.environ.get("BENCH_FLASH") == "1":
             # opt-in: the L=4096 flash-attention Mosaic compile has been

@@ -82,26 +82,39 @@ def main() -> None:
     ap.add_argument("src", help="source checkpoint (.pt/.pth/.pytorch/.bin/.npz)")
     ap.add_argument(
         "dst",
-        help="output: a .msgpack file, or (no suffix) an orbax checkpoint "
-        "directory — the sharded format a mesh/multi-host run restores "
-        "directly onto its devices",
+        help="output: a .msgpack file, or an orbax checkpoint directory — "
+        "the sharded format a mesh/multi-host run restores directly onto "
+        "its devices",
+    )
+    ap.add_argument(
+        "--format",
+        choices=["msgpack", "orbax"],
+        default=None,
+        help="output format; default infers from dst (.msgpack suffix -> "
+        "msgpack, otherwise orbax directory). Pass explicitly when the "
+        "dst name would mislead inference (advisor r02: a dotted dir "
+        "name like ./weights/clip.b32 infers wrong, and a typo'd "
+        "extensionless msgpack path silently became a directory)",
     )
     args = ap.parse_args()
 
     from video_features_tpu.models.common.weights import load_params, save_orbax
 
     # validate dst BEFORE the (potentially multi-GB) load+convert
-    as_msgpack = args.dst.endswith(".msgpack")
-    if not as_msgpack:
-        # allowlist: an orbax dst is a DIRECTORY name — any file-like
-        # suffix (.msgpak typo, .ckpt, .npz, ...) is a user mistake
-        if os.path.splitext(os.path.basename(args.dst))[1]:
+    if args.format is not None:
+        as_msgpack = args.format == "msgpack"
+    else:
+        as_msgpack = args.dst.endswith(".msgpack")
+        if not as_msgpack and os.path.splitext(os.path.basename(args.dst))[1]:
+            # inference refuses ambiguity: a file-like suffix that isn't
+            # .msgpack (.msgpak typo, .ckpt, a dotted dir name) needs the
+            # explicit --format
             raise SystemExit(
-                f"dst must be .msgpack or an orbax directory (no file "
-                f"suffix), got {args.dst}"
+                f"dst {args.dst!r} has a file-like suffix but isn't "
+                f".msgpack — pass --format msgpack or --format orbax"
             )
-        if os.path.exists(args.dst):
-            raise SystemExit(f"orbax dst already exists: {args.dst}")
+    if not as_msgpack and os.path.exists(args.dst):
+        raise SystemExit(f"orbax dst already exists: {args.dst}")
 
     params = load_params(args.src, convert_fn(args.feature_type))
     if as_msgpack:

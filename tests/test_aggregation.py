@@ -1,0 +1,234 @@
+"""Cross-video batch aggregation (--video_batch).
+
+The reference dispatches one video at a time (ref models/CLIP/
+extract_clip.py:107-128 — a single ~12-frame batch per forward); with
+frozen weights nothing distinguishes frames of different videos, so N
+videos' batches can share one fused forward (SURVEY.md §5). These tests
+pin the contract: aggregated features == individual features, per-video
+error isolation survives fused dispatch, partial groups flush, and the
+save path still writes one file set per video.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig
+
+
+@pytest.fixture(scope="module")
+def four_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    root = tmp_path_factory.mktemp("agg_media")
+    return [
+        synth_video(str(root / f"v{i}.mp4"), n_frames=24 + 8 * i, seed=i)
+        for i in range(4)
+    ]
+
+
+def _clip_cfg(paths, tmp_path, **kw):
+    return ExtractionConfig(
+        allow_random_init=True,
+        feature_type="CLIP-ViT-B/32",
+        video_paths=list(paths),
+        extract_method="uni_4",
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+        **kw,
+    )
+
+
+def test_clip_aggregated_matches_individual(four_videos, tmp_path):
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    solo = ExtractCLIP(_clip_cfg(four_videos, tmp_path), external_call=True)()
+    # group=3 over 4 videos: one full group + one partial flush
+    fused = ExtractCLIP(
+        _clip_cfg(four_videos, tmp_path, video_batch=3), external_call=True
+    )()
+    assert len(solo) == len(fused) == 4
+    for s, f in zip(solo, fused):
+        assert f["CLIP-ViT-B/32"].shape == (4, 512)
+        np.testing.assert_allclose(
+            f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+        np.testing.assert_array_equal(f["timestamps_ms"], s["timestamps_ms"])
+
+
+def test_clip_aggregated_save_numpy(four_videos, tmp_path):
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(
+        four_videos, tmp_path, video_batch=4, on_extraction="save_numpy"
+    )
+    ExtractCLIP(cfg)()
+    saved = sorted(pathlib.Path(tmp_path / "out").rglob("*.npy"))
+    assert len(saved) == 4
+    for f in saved:
+        assert np.load(f).shape == (4, 512)
+
+
+def test_clip_aggregation_isolates_bad_video(four_videos, tmp_path, capsys):
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"not a video")
+    paths = [four_videos[0], str(bad), four_videos[1]]
+    fused = ExtractCLIP(
+        _clip_cfg(paths, tmp_path, video_batch=3), external_call=True
+    )()
+    # the bad video fails in prepare; the two good ones still fuse + return
+    assert len(fused) == 2
+    assert "An error occurred" in capsys.readouterr().out
+    for r in fused:
+        assert r["CLIP-ViT-B/32"].shape == (4, 512)
+
+
+def test_resnet_aggregated_matches_individual(four_videos, tmp_path):
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    def cfg(vb):
+        return ExtractionConfig(
+            allow_random_init=True,
+            feature_type="resnet18",
+            video_paths=list(four_videos[:3]),
+            batch_size=8,
+            video_batch=vb,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+
+    solo = ExtractResNet(cfg(1), external_call=True)()
+    fused = ExtractResNet(cfg(3), external_call=True)()
+    assert len(solo) == len(fused) == 3
+    for i, (s, f) in enumerate(zip(solo, fused)):
+        # videos have 24/32/40 frames — re-chunked rows must split back
+        assert f["resnet18"].shape == (24 + 8 * i, 512)
+        np.testing.assert_allclose(f["resnet18"], s["resnet18"], atol=2e-4, rtol=1e-4)
+        np.testing.assert_array_equal(f["timestamps_ms"], s["timestamps_ms"])
+
+
+def test_resnet_agg_key_declines_oversized_and_stream(four_videos, tmp_path):
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    ex = ExtractResNet(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="resnet18",
+            video_paths=list(four_videos[:1]),
+            batch_size=4,
+            video_batch=2,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        ),
+        external_call=True,
+    )
+    payload = ex.prepare(four_videos[0])
+    assert ex.agg_key(payload) is not None
+    assert ex.agg_key(("stream", four_videos[0])) is None
+    old = ex.AGG_MAX_FRAMES
+    try:
+        ex.AGG_MAX_FRAMES = 3  # the 24-frame video now exceeds the cap
+        assert ex.agg_key(payload) is None
+    finally:
+        ex.AGG_MAX_FRAMES = old
+
+
+def test_r21d_aggregated_matches_individual(four_videos, tmp_path):
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+
+    def cfg(vb):
+        return ExtractionConfig(
+            allow_random_init=True,
+            feature_type="r21d_rgb",
+            video_paths=list(four_videos[:3]),
+            batch_size=2,
+            video_batch=vb,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+
+    solo = ExtractR21D(cfg(1), external_call=True)()
+    fused = ExtractR21D(cfg(3), external_call=True)()
+    assert len(solo) == len(fused) == 3
+    for i, (s, f) in enumerate(zip(solo, fused)):
+        # 24/32/40 frames -> 1/2/2 complete 16-frame stacks
+        assert f["r21d_rgb"].shape == s["r21d_rgb"].shape
+        np.testing.assert_allclose(f["r21d_rgb"], s["r21d_rgb"], atol=2e-4, rtol=1e-4)
+
+
+def test_mixed_agg_paths_preserve_input_order(four_videos, tmp_path):
+    """external_call results must come back in input order even when an
+    agg_key=None video dispatches (and completes) ahead of videos still
+    buffering in a group (code-review r03 finding #1)."""
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="resnet18",
+        video_paths=list(four_videos),  # 24/32/40/48 frames
+        batch_size=8,
+        video_batch=3,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    ex = ExtractResNet(cfg, external_call=True)
+    # v1 (32 frames) exceeds the cap -> individual path, overtaking v0/v2
+    ex.AGG_MAX_FRAMES = 30
+    solo = ExtractResNet(cfg.replace(video_batch=1), external_call=True)()
+    fused = ex()
+    assert len(fused) == 4
+    for i, (s, f) in enumerate(zip(solo, fused)):
+        assert f["resnet18"].shape[0] == 24 + 8 * i  # order = input order
+        np.testing.assert_allclose(f["resnet18"], s["resnet18"], atol=2e-4, rtol=1e-4)
+
+
+def test_video_batch_requires_decode_workers():
+    from video_features_tpu.config import sanity_check
+
+    with pytest.raises(ValueError, match="decode_workers"):
+        sanity_check(
+            ExtractionConfig(
+                feature_type="resnet18", video_batch=4, decode_workers=0
+            )
+        )
+
+
+def test_clip_agg_key_declines_oversized(four_videos, tmp_path):
+    """fix_N over a long video yields huge payloads; they must dispatch
+    alone (code-review r03 finding #2)."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    ex = ExtractCLIP(
+        _clip_cfg(four_videos[:1], tmp_path, video_batch=2), external_call=True
+    )
+    payload = ex.prepare(four_videos[0])
+    assert ex.agg_key(payload) is not None
+    ex.AGG_MAX_FRAMES = 2
+    assert ex.agg_key(payload) is None
+
+
+def test_base_extractor_declines_aggregation_by_default(four_videos, tmp_path):
+    """Extractors without dispatch_group ignore --video_batch (no crash)."""
+    from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
+
+    ex = ExtractVGGish(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="vggish",
+            video_paths=list(four_videos[:1]),
+            video_batch=4,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        ),
+        external_call=True,
+    )
+    assert not ex._aggregation_enabled()

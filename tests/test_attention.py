@@ -46,6 +46,39 @@ def test_fused_attention_matches_numpy():
     assert np.allclose(np.asarray(out), ref, atol=1e-5)
 
 
+def test_all_masked_prefix_is_cancelled():
+    """Masked-block pollution of (l, acc) must be erased by the fp32
+    underflow of the correction factor once a valid block arrives: an
+    all-masked PREFIX (garbage v in the padding) must not leak into the
+    output (the contract _finalize documents)."""
+    from video_features_tpu.ops.attention import init_carry, online_softmax_step
+
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng, lk=16)
+    scale = q.shape[-1] ** -0.5
+    # poison the first 8 KV positions with huge values, then mask them
+    v = v.at[:, :, :8].set(1e6)
+    m, l, acc = init_carry(q)
+    mask0 = jnp.zeros((1, 1, 1, 8), bool)
+    m, l, acc = online_softmax_step(q, k[:, :, :8], v[:, :, :8], m, l, acc, scale, mask0)
+    assert float(jnp.max(l)) > 0  # the documented pollution is real
+    m, l, acc = online_softmax_step(q, k[:, :, 8:], v[:, :, 8:], m, l, acc, scale)
+    from video_features_tpu.ops.attention import _finalize
+
+    out_masked = _finalize(m, l, acc, q.dtype)
+    ref = _numpy_attention(q, k[:, :, 8:], v[:, :, 8:])
+    np.testing.assert_allclose(np.asarray(out_masked), ref, atol=1e-5)
+    assert np.abs(np.asarray(out_masked)).max() < 1e3  # no 1e6 leakage
+
+
+def test_kv_len_zero_rejected():
+    q, k, v = _qkv(np.random.default_rng(8))
+    with pytest.raises(ValueError, match="kv_len"):
+        attention(q, k, v, kv_len=0)
+    with pytest.raises(ValueError, match="kv_len"):
+        blockwise_attention(q, k, v, kv_len=0)
+
+
 def test_fused_attention_kv_mask():
     q, k, v = _qkv(np.random.default_rng(1))
     out = attention(q, k, v, kv_len=13)

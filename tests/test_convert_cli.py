@@ -53,11 +53,32 @@ def test_convert_cli_resnet_roundtrip(tmp_path, capsys):
 
 
 def test_convert_cli_rejects_checkpoint_suffix_dst(tmp_path):
-    """A dst that looks like a source-format file is a user mistake; only
-    .msgpack files and orbax directories (no file suffix) are outputs."""
+    """Suffix inference refuses ambiguity: a non-.msgpack file-like dst
+    needs an explicit --format (advisor r02: dotted dir names inferred
+    wrong; typo'd extensionless paths silently became directories)."""
     from tests.test_resnet import _torch_oracle
 
     src = tmp_path / "w.pt"
     torch.save(_torch_oracle("resnet18").state_dict(), src)
-    with pytest.raises(SystemExit, match="msgpack or an orbax"):
+    with pytest.raises(SystemExit, match="--format"):
         _run_cli(["--feature_type", "resnet18", str(src), str(tmp_path / "o.npz")])
+
+
+def test_convert_cli_explicit_format_overrides_inference(tmp_path):
+    """--format orbax allows a dotted directory name; --format msgpack
+    allows an extensionless file path."""
+    from tests.test_resnet import _torch_oracle
+
+    pytest.importorskip("orbax.checkpoint")
+    src = tmp_path / "w.pt"
+    torch.save(_torch_oracle("resnet18").state_dict(), src)
+    dotted_dir = tmp_path / "resnet.v1"
+    _run_cli(
+        ["--feature_type", "resnet18", "--format", "orbax", str(src), str(dotted_dir)]
+    )
+    assert dotted_dir.is_dir()
+    bare_file = tmp_path / "resnet_msgpack"
+    _run_cli(
+        ["--feature_type", "resnet18", "--format", "msgpack", str(src), str(bare_file)]
+    )
+    assert bare_file.is_file()

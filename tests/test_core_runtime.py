@@ -256,8 +256,8 @@ def test_sparse_seek_decode_matches_sequential(tmp_path):
     from video_features_tpu.utils.synth import synth_video
 
     video = synth_video(str(tmp_path / "long.mp4"), n_frames=200, width=64, height=48)
-    sparse_ix = [3, 50, 120, 199]  # 4*8 < 200 -> seek path
-    sparse = read_frames_at_indices(video, sparse_ix)
+    sparse_ix = [3, 50, 120, 199]  # 4*16 < 200 -> seek path (opt-in)
+    sparse = read_frames_at_indices(video, sparse_ix, allow_seek=True)
     dense = read_frames_at_indices(video, list(range(200)))  # sequential path
     assert sorted(sparse) == sparse_ix
     for i in sparse_ix:

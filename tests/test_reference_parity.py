@@ -18,11 +18,13 @@ model width on realistic input shapes:
 
 The reference tree has no __init__.py files; with /root/reference appended
 to sys.path its ``models.*`` imports resolve as implicit namespace
-packages. torchvision and the pip ``clip`` package are NOT in this env, so
-ResNet/R21D keep their torchvision-format builder oracles
-(tests/test_resnet.py, tests/test_r21d.py) and CLIP's independent oracle
-is transformers' CLIPVisionModelWithProjection — exercised at full
-ViT-B/32 width here (round 1 covered only a toy config).
+packages. CLIP's independent oracle is transformers'
+CLIPVisionModelWithProjection — exercised at full ViT-B/32 width here.
+ResNet/R21D are oracled against the REAL torchvision modules the
+reference consumes (skip-if-unimportable: CI installs torchvision via
+the [oracle] extra; this env doesn't ship it, where the
+torchvision-format builder oracles in tests/test_resnet.py /
+tests/test_r21d.py still run).
 """
 
 import importlib
@@ -271,6 +273,81 @@ def test_pca_postprocess_matches_reference_source():
 
 
 # --- CLIP at full ViT-B/32 width (independent transformers oracle) ---------
+
+
+def test_resnet50_matches_real_torchvision():
+    """Full-width resnet50 vs the REAL torchvision module the reference
+    consumes (ref models/resnet/extract_resnet.py:55) — randomized weights
+    AND BN running stats through our converter. Replaces the last
+    builder-written oracle risk for this family (VERDICT r02 #3); skips
+    where torchvision isn't installed (CI installs it via [oracle])."""
+    tv = pytest.importorskip("torchvision")
+
+    from video_features_tpu.models.resnet.convert import convert_state_dict
+    from video_features_tpu.models.resnet.model import build
+
+    torch.manual_seed(0)
+    oracle = tv.models.resnet50(weights=None)
+    _randomize_bn_stats(oracle)
+    oracle.eval()
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd, "resnet50")
+
+    x = np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32)
+    with torch.no_grad():
+        xt = torch.from_numpy(x)
+        feats_ref = torch.flatten(
+            oracle.avgpool(
+                oracle.layer4(
+                    oracle.layer3(
+                        oracle.layer2(
+                            oracle.layer1(
+                                oracle.maxpool(
+                                    torch.relu(oracle.bn1(oracle.conv1(xt)))
+                                )
+                            )
+                        )
+                    )
+                )
+            ),
+            1,
+        ).numpy()
+        logits_ref = oracle(xt).numpy()
+    feats, logits = build("resnet50").apply({"params": params}, jnp.asarray(x))
+    assert np.asarray(feats).shape == feats_ref.shape == (2, 2048)
+    np.testing.assert_allclose(np.asarray(feats), feats_ref, atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(logits), logits_ref, atol=2e-4, rtol=1e-4)
+
+
+def test_r2plus1d_matches_real_torchvision():
+    """Full-width r2plus1d_18 vs the REAL torchvision video model the
+    reference consumes (ref models/r21d/extract_r21d.py:65), through our
+    converter; skips where torchvision isn't installed."""
+    tv = pytest.importorskip("torchvision")
+
+    from video_features_tpu.models.r21d.convert import convert_state_dict
+    from video_features_tpu.models.r21d.model import build
+
+    torch.manual_seed(0)
+    oracle = tv.models.video.r2plus1d_18(weights=None)
+    _randomize_bn_stats(oracle)
+    oracle.eval()
+    sd = {k: v.numpy() for k, v in oracle.state_dict().items()}
+    params = convert_state_dict(sd)
+
+    # (N, T, H, W, C) fp32 in [0,1]-ish post-preprocess space; torchvision
+    # wants (N, C, T, H, W)
+    x = np.random.RandomState(1).randn(1, 8, 112, 112, 3).astype(np.float32)
+    with torch.no_grad():
+        xt = torch.from_numpy(x.transpose(0, 4, 1, 2, 3))
+        stem = oracle.stem(xt)
+        h = oracle.layer4(oracle.layer3(oracle.layer2(oracle.layer1(stem))))
+        feats_ref = torch.flatten(oracle.avgpool(h), 1).numpy()
+        logits_ref = oracle(xt).numpy()
+    feats, logits = build().apply({"params": params}, jnp.asarray(x))
+    assert np.asarray(feats).shape == feats_ref.shape == (1, 512)
+    np.testing.assert_allclose(np.asarray(feats), feats_ref, atol=3e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(logits), logits_ref, atol=3e-4, rtol=1e-4)
 
 
 def test_clip_full_width_matches_hf_oracle():

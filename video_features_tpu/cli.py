@@ -1,0 +1,53 @@
+"""CLI entry point: ``video-features-tpu --feature_type <X> ...``
+(or ``python main.py ...`` via the repo-root shim).
+
+Drop-in surface for the reference CLI (ref main.py:94-149): same flags,
+same feature types, same output contract. ``--device_ids`` indexes
+``jax.devices()`` (TPU chips under TPU runtimes); ``--cpu`` forces the CPU
+backend. Dispatch goes through one code path — the dynamic work-queue
+scheduler — for both single- and multi-device runs.
+"""
+
+import sys
+
+from video_features_tpu.config import parse_args
+from video_features_tpu.extract.registry import build_extractor
+from video_features_tpu.parallel.devices import resolve_devices
+from video_features_tpu.parallel.scheduler import (
+    mesh_feature_extraction,
+    parallel_feature_extraction,
+)
+
+
+def main(argv=None) -> None:
+    import os
+
+    cfg = parse_args(argv)
+
+    # Multi-host slices: when a launcher provides a coordinator (e.g.
+    # JAX_COORDINATOR_ADDRESS on a TPU pod), join the distributed runtime
+    # before touching devices — jax.devices() then spans hosts and a
+    # --sharding mesh rides ICI for collectives, DCN for dispatch. After
+    # arg validation (a --help/typo run must not block on the barrier),
+    # never for --cpu, and only once per process (initialize is once-only).
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and not cfg.cpu:
+        import jax
+
+        if not getattr(main, "_distributed_initialized", False):
+            jax.distributed.initialize()
+            main._distributed_initialized = True
+    if cfg.on_extraction in ("save_numpy", "save_pickle"):
+        print(f"Saving features to {cfg.output_path}")
+    if cfg.keep_tmp_files:
+        print(f"Keeping temp files in {cfg.tmp_path}")
+
+    extractor = build_extractor(cfg)
+    devices = resolve_devices(cfg)
+    if cfg.sharding == "mesh":
+        mesh_feature_extraction(extractor, devices)
+    else:
+        parallel_feature_extraction(extractor, devices)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -131,6 +131,28 @@ class ExtractionConfig:
     sharding: str = "queue"
     # 'model' (tensor-parallel) axis size of the mesh; 'data' gets the rest.
     mesh_model: int = 1
+    # Attention core for the transformer extractors (CLIP family):
+    #   'fused'     — full-score-matrix core; the right answer at ViT's
+    #                 50/197 tokens (the whole matrix fits in VMEM).
+    #   'flash'     — the Pallas flash-attention kernel
+    #                 (ops/pallas/flash_attention.py): O(block) score
+    #                 memory, the single-chip long-sequence core.
+    #   'blockwise' — the XLA lax.scan online-softmax core (same math as
+    #                 flash, no Pallas dependency).
+    # All three are mathematically exact, so converted OpenAI weights
+    # give identical features (tests/test_aggregation.py pins flash==fused
+    # on the real extractor path). Non-transformer extractors ignore this.
+    attn: str = "fused"
+    # Cross-video batch aggregation: group up to this many prepared
+    # videos' (same-shape) batches into ONE device dispatch, slicing
+    # features apart per video on fetch (extract/base.py aggregation
+    # protocol). 1 = off. The single-video batches the reference
+    # dispatches (~12 CLIP frames, ~2 R21D stacks) leave an accelerator
+    # >99% idle; with frozen weights nothing distinguishes frames of
+    # different videos, so they can share a forward (SURVEY.md §5).
+    # Requires decode_workers >= 1 (the async pipeline hosts the
+    # grouping); show_pred keeps per-video dispatch.
+    video_batch: int = 1
     # Context parallelism (--sharding mesh only): shard the transformer's
     # token axis over the mesh 'data' axis and run ring attention — KV
     # shards rotate chip-to-chip over ICI (parallel/ring_attention.py) —
@@ -191,6 +213,22 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise ValueError(f"mesh_model must be >= 1, got {cfg.mesh_model}")
     if cfg.mesh_context and cfg.sharding != "mesh":
         raise ValueError("--mesh_context requires --sharding mesh")
+    if cfg.video_batch < 1:
+        raise ValueError(f"video_batch must be >= 1, got {cfg.video_batch}")
+    if cfg.video_batch > 1 and int(cfg.decode_workers or 0) < 1:
+        raise ValueError(
+            "--video_batch needs the async pipeline: set --decode_workers "
+            ">= 1 (aggregation groups prepared videos, and only "
+            "_run_pipelined prepares ahead)"
+        )
+    if cfg.attn not in ("fused", "flash", "blockwise"):
+        raise ValueError(f"unknown attn core: {cfg.attn}")
+    if cfg.mesh_context and cfg.attn != "fused":
+        raise ValueError(
+            "--mesh_context injects the ring-attention core; it cannot "
+            "combine with --attn flash/blockwise (ring already chunks KV "
+            "blockwise per arriving shard)"
+        )
     return cfg
 
 
@@ -249,6 +287,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(data, model) mesh of all selected devices")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis size of the --sharding mesh")
+    p.add_argument("--attn", default="fused",
+                   choices=["fused", "flash", "blockwise"],
+                   help="attention core for the CLIP family: fused "
+                        "full-score (default, best at ViT lengths), the "
+                        "Pallas flash kernel, or the XLA blockwise core")
+    p.add_argument("--video_batch", type=int, default=1,
+                   help="aggregate up to N videos' prepared batches into "
+                        "one device dispatch (CLIP/ResNet/R21D); 1 = off")
     p.add_argument("--mesh_context", action="store_true",
                    help="context parallelism under --sharding mesh: shard "
                         "the transformer token axis over the mesh and run "

@@ -136,9 +136,13 @@ class BaseExtractor:
     def _supports_pipeline(self) -> bool:
         return type(self).prepare is not BaseExtractor.prepare
 
-    def _sink_or_collect(self, feats_dict, entry, results) -> None:
+    def _sink_or_collect(self, feats_dict, entry, results, order: int = 0) -> None:
+        """``order`` is the video's position in the caller's indices:
+        external_call results are returned sorted by it, so aggregation's
+        out-of-order completion (a full group can overtake an agg_key=None
+        video, and vice versa) never reorders what the caller sees."""
         if self.external_call:
-            results.append(feats_dict)
+            results.append((order, feats_dict))
         else:
             with self.timer.stage("sink"):
                 action_on_extraction(
@@ -179,7 +183,7 @@ class BaseExtractor:
             device = self._default_device()
         state = self.warmup(device)
 
-        results: List[Dict[str, np.ndarray]] = []
+        results: List = []  # external_call: (order, feats_dict) pairs
         indices = [int(i) for i in indices]
         pipelined = (
             self._supports_pipeline()
@@ -190,10 +194,10 @@ class BaseExtractor:
             if pipelined:
                 self._run_pipelined(indices, device, state, results)
             else:
-                for idx in indices:
+                for pos, idx in enumerate(indices):
                     entry = self.path_list[idx]
 
-                    def one(entry=entry):
+                    def one(entry=entry, pos=pos):
                         if (
                             self.config.resume
                             and not self.external_call
@@ -202,13 +206,13 @@ class BaseExtractor:
                             return
                         with self.timer.stage("extract"):
                             feats_dict = self.extract(device, state, entry)
-                        self._sink_or_collect(feats_dict, entry, results)
+                        self._sink_or_collect(feats_dict, entry, results, pos)
 
                     self._isolate(entry, one)
         if self.config.profile_dir:
             print(self.timer.summary())
         if self.external_call:
-            return results
+            return [d for _, d in sorted(results, key=lambda t: t[0])]
         return None
 
     def _run_pipelined(self, indices, device, state, results) -> None:
@@ -220,7 +224,16 @@ class BaseExtractor:
 
         While video k's jitted forward runs (XLA dispatch is async; the
         blocking point is fetching its result), videos k+1..k+W are
-        already decoding — the host/device double-buffer."""
+        already decoding — the host/device double-buffer.
+
+        With ``--video_batch N`` (and an agg-capable extractor), prepared
+        videos whose batches share a static shape (``agg_key``) buffer up
+        into groups of N and cross the device as ONE fused dispatch
+        (``dispatch_group``/``fetch_group``) — N videos' frames fill one
+        forward instead of N tiny ones. Up to N-1 prepared payloads per
+        shape key stay host-resident while a group fills; extractors
+        whose payloads can be large return ``agg_key=None`` above a size
+        cap, which routes that video through the individual path."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
@@ -236,27 +249,62 @@ class BaseExtractor:
         # video's transfer+compute stays in flight while the previous
         # video's results are fetched/sunk
         split = self._supports_device_pipeline()
-        inflight: deque = deque()  # (entry, handle)
+        agg = self._aggregation_enabled()
+        group_size = max(int(self.config.video_batch or 1), 1)
+        groups: Dict[Any, list] = {}  # agg_key -> [(pos, entry, payload)]
+        inflight: deque = deque()  # ([(pos, entry), ...], handle, grouped)
 
         def fetch_one():
-            entry, handle = inflight.popleft()
+            slots, handle, grouped = inflight.popleft()
+            if grouped:
+                try:
+                    with self.timer.stage("device"):
+                        dicts = self.fetch_group(handle)
+                except KeyboardInterrupt:
+                    raise
+                except Exception:  # noqa: BLE001 - the fused fetch fails together
+                    for _, e in slots:
+                        self._report_video_error(e)
+                    return
+                for (pos, e), d in zip(slots, dicts):
+                    self._isolate(e, self._sink_or_collect, d, e, results, pos)
+                return
+            pos, entry = slots[0]
 
             def one():
                 with self.timer.stage("device"):
                     feats_dict = self.fetch_dispatched(handle)
-                self._sink_or_collect(feats_dict, entry, results)
+                self._sink_or_collect(feats_dict, entry, results, pos)
 
             self._isolate(entry, one)
 
-        def consume_one():
-            idx, fut = pending.popleft()
-            entry = self.path_list[idx]
+        def dispatch_group_now(items):  # items: [(pos, entry, payload)]
+            entries = [e for _, e, _ in items]
+            try:
+                with self.timer.stage("device"):
+                    handle = self.dispatch_group(
+                        device, state, entries, [p for _, _, p in items]
+                    )
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - the fused dispatch fails together
+                for e in entries:
+                    self._report_video_error(e)
+                return
+            inflight.append(([(pos, e) for pos, e, _ in items], handle, True))
+            if len(inflight) > 1:
+                fetch_one()
+
+        def dispatch_single(pos, entry, payload):
             if split:
                 try:
-                    payload = fut.result()
                     with self.timer.stage("device"):
                         inflight.append(
-                            (entry, self.dispatch_prepared(device, state, entry, payload))
+                            (
+                                [(pos, entry)],
+                                self.dispatch_prepared(device, state, entry, payload),
+                                False,
+                            )
                         )
                 except KeyboardInterrupt:
                     raise
@@ -267,17 +315,36 @@ class BaseExtractor:
                 return
 
             def one():
-                payload = fut.result()
                 with self.timer.stage("device"):
                     feats_dict = self.extract_prepared(device, state, entry, payload)
-                self._sink_or_collect(feats_dict, entry, results)
+                self._sink_or_collect(feats_dict, entry, results, pos)
 
             self._isolate(entry, one)
+
+        def consume_one():
+            pos, idx, fut = pending.popleft()
+            entry = self.path_list[idx]
+            try:
+                payload = fut.result()
+                key = self.agg_key(payload) if agg else None
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - prepare failed: this video only
+                self._report_video_error(entry)
+                return
+            if key is not None:
+                buf = groups.setdefault(key, [])
+                buf.append((pos, entry, payload))
+                if len(buf) >= group_size:
+                    del groups[key]
+                    dispatch_group_now(buf)
+                return
+            dispatch_single(pos, entry, payload)
 
         with ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"decode-{device}"
         ) as pool:
-            for idx in indices:
+            for pos, idx in enumerate(indices):
                 entry = self.path_list[idx]
                 if (
                     self.config.resume
@@ -286,11 +353,15 @@ class BaseExtractor:
                 ):
                     self.progress.update()
                     continue
-                pending.append((idx, pool.submit(prep, entry)))
+                pending.append((pos, idx, pool.submit(prep, entry)))
                 if len(pending) > depth:
                     consume_one()
             while pending:
                 consume_one()
+            for buf in groups.values():  # flush partial groups (< N videos)
+                if buf:
+                    dispatch_group_now(buf)
+            groups.clear()
             while inflight:
                 fetch_one()
 
@@ -333,6 +404,78 @@ class BaseExtractor:
 
     def _supports_device_pipeline(self) -> bool:
         return type(self).dispatch_prepared is not BaseExtractor.dispatch_prepared
+
+    # --- cross-video aggregation (--video_batch) --------------------------
+    def _supports_aggregation(self) -> bool:
+        return type(self).dispatch_group is not BaseExtractor.dispatch_group
+
+    def _aggregation_enabled(self) -> bool:
+        return (
+            self._supports_aggregation()
+            and max(int(getattr(self.config, "video_batch", 1) or 1), 1) > 1
+        )
+
+    def agg_key(self, payload):
+        """Hashable static-shape key for ``--video_batch`` grouping:
+        payloads with equal keys may fuse into one dispatch. ``None``
+        routes this video through the individual dispatch path (the
+        extractor's opt-out for oversized payloads or show_pred)."""
+        return None
+
+    def dispatch_group(self, device, state, entries, payloads):
+        """Fuse up to ``--video_batch`` same-key payloads into one
+        transfer + jitted forward; return a handle without fetching.
+        Implementations must pad the fused batch to the full-group shape
+        so XLA compiles exactly one executable per agg_key."""
+        raise NotImplementedError
+
+    def fetch_group(self, handle):
+        """Blocking half of ``dispatch_group``: fetch once, slice per
+        video, return the feats_dicts in ``entries`` order."""
+        raise NotImplementedError
+
+    def _dispatch_rows_grouped(self, state, rows, chunk_rows):
+        """Shared chunked re-dispatch for row-batched aggregation (ResNet
+        frames, R21D stacks): concatenate the videos' valid rows, run one
+        padded ``state['forward']`` per ``chunk_rows`` chunk (a single
+        compiled shape per agg_key), return ``[(feats, n_valid)]``
+        handles without fetching."""
+        import numpy as _np
+
+        from video_features_tpu.ops.window import pad_batch
+        from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
+        all_rows = _np.concatenate(rows, axis=0)
+        outs = []
+        for i in range(0, all_rows.shape[0], chunk_rows):
+            piece = all_rows[i : i + chunk_rows]
+            n = piece.shape[0]
+            x = pad_batch(piece, chunk_rows)
+            x = pad_batch_for(state["device"], x)
+            x = place_batch(x, state["device"])
+            feats, _ = state["forward"](state["params"], x)
+            outs.append((feats, n))
+        return outs
+
+    @staticmethod
+    def _split_grouped_rows(outs, totals):
+        """Fetch ``_dispatch_rows_grouped`` handles and split the row axis
+        back into per-video arrays (``totals`` rows each, input order)."""
+        import numpy as _np
+
+        feats_cat = _np.concatenate([_np.asarray(f)[:n] for f, n in outs], axis=0)
+        arrays, off = [], 0
+        for total in totals:
+            arrays.append(feats_cat[off : off + total])
+            off += total
+        return arrays
+
+    def _prefetch_frame_cap(self, max_bytes: int, frame_bytes: int, floor: int) -> int:
+        """Per-video prefetch cap in frames: the shared byte budget split
+        over the decode_workers+2 resident prepared-video slots (advisor
+        r02: flat frame caps scaled host RAM with the worker count)."""
+        resident = max(int(self.config.decode_workers or 0), 1) + 2
+        return max(max_bytes // resident // frame_bytes, floor)
 
     def dispatch_prepared(self, device, state, path_entry, payload):
         """Optional split of ``extract_prepared``: enqueue the host->HBM

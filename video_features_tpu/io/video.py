@@ -154,18 +154,21 @@ def probe(path: str, decoder: Optional[str] = None) -> VideoMeta:
 
 
 def read_frames_at_indices(
-    path: str, indices, decoder: Optional[str] = None, allow_seek: bool = True
+    path: str, indices, decoder: Optional[str] = None, allow_seek: bool = False
 ) -> dict:
     """Decode returning {index: rgb_uint8_hwc} for the wanted frame
     indices; indices past the decodable end are simply absent.
 
-    When the wanted set is sparse relative to its span (e.g. I3D with a
-    low ``--extraction_fps`` over a long video), seeks via
-    ``CAP_PROP_POS_FRAMES`` instead of decoding every frame up to
-    ``max(indices)`` — the analog of the reference's ``mmcv
-    VideoReader.get_frame`` random access (ref extract_i3d.py:246-248).
-    Dense sets keep the sequential decode (seek + keyframe re-decode
-    would be slower, and sequential reads are always frame-exact)."""
+    ``allow_seek=True`` (opt-in): when the wanted set is sparse relative
+    to its span, seeks via ``CAP_PROP_POS_FRAMES`` instead of decoding
+    every frame up to ``max(indices)`` — the analog of the reference's
+    ``mmcv VideoReader.get_frame`` random access (ref
+    extract_i3d.py:246-248). The default is the always-frame-exact
+    sequential decode: POS_FRAMES seeks can land off-by-frames on
+    open-GOP/B-frame streams while still passing the position-readback
+    guard below, so no feature path enables seeking (VERDICT r02 #5) —
+    it remains available for callers whose accuracy needs are looser
+    than the sampled-feature contract."""
     need = sorted(set(int(i) for i in indices))
     if not need:
         return {}

@@ -90,6 +90,23 @@ class ExtractCLIP(BaseExtractor):
             )
 
             attn_core = make_context_parallel_core(device)
+        elif self.config.attn == "flash":
+            # --attn flash: the Pallas kernel on the REAL extraction path
+            # (VERDICT r02 #8). Exact vs fused, so features are unchanged;
+            # off-TPU backends run the kernel in interpreter mode.
+            import functools
+
+            from video_features_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+
+            attn_core = functools.partial(
+                flash_attention, interpret=jax.default_backend() != "tpu"
+            )
+        elif self.config.attn == "blockwise":
+            from video_features_tpu.ops.attention import blockwise_attention
+
+            attn_core = blockwise_attention
         else:
             attn_core = None
         model = VisionTransformer(self.model_cfg, dtype=dt, attn_core=attn_core)
@@ -173,17 +190,20 @@ class ExtractCLIP(BaseExtractor):
     # device half, split for the device pipeline (extract/base.py): enqueue
     # transfer + async forward, fetch later — video k+1's transfer/compute
     # overlaps video k's result fetch
-    def dispatch_prepared(self, device, state, path_entry, payload):
+    def _place(self, state, padded):
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
-        padded, T, fps, timestamps_ms = payload
         if state.get("pad_data", True):  # mesh DP: /data-divisible batch
             padded = pad_batch_for(state["device"], padded)
-            x = place_batch(padded, state["device"])
-        else:  # mesh_context: batch replicates, tokens shard in-model
-            from jax.sharding import PartitionSpec as P
+            return place_batch(padded, state["device"])
+        # mesh_context: batch replicates, tokens shard in-model
+        from jax.sharding import PartitionSpec as P
 
-            x = place_batch(padded, state["device"], spec=P())
+        return place_batch(padded, state["device"], spec=P())
+
+    def dispatch_prepared(self, device, state, path_entry, payload):
+        padded, T, fps, timestamps_ms = payload
+        x = self._place(state, padded)
         return state["encode_image"](state["params"], x), T, fps, timestamps_ms
 
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
@@ -193,3 +213,39 @@ class ExtractCLIP(BaseExtractor):
             "fps": np.array(fps),
             "timestamps_ms": np.array(timestamps_ms),
         }
+
+    # --- cross-video aggregation (--video_batch): N videos' sampled-frame
+    # batches concatenate into ONE (N*bucket)-image encode_image call;
+    # features slice apart per video on fetch. A lone uni_12 batch (12-16
+    # images) leaves the MXU ~idle — the fused batch is what fills it.
+    # Above AGG_MAX_FRAMES sampled frames (fix_N over a long video), a
+    # video dispatches alone: N-1 such payloads waiting host-side plus an
+    # N-fold fused transfer is the OOM shape the cap exists to avoid.
+    AGG_MAX_FRAMES = 256
+
+    def agg_key(self, payload):
+        if payload[0].shape[0] > self.AGG_MAX_FRAMES:
+            return None
+        return payload[0].shape  # the bucketed (T_pad, 3, H, W) shape
+
+    def dispatch_group(self, device, state, entries, payloads):
+        group = max(int(self.config.video_batch or 1), 1)
+        bucket = payloads[0][0].shape[0]
+        x = np.concatenate([p[0] for p in payloads], axis=0)
+        if len(payloads) < group:  # partial flush: keep the compiled shape
+            x = pad_batch(x, group * bucket)
+        out = state["encode_image"](state["params"], self._place(state, x))
+        metas = [(i * bucket, p[1], p[2], p[3]) for i, p in enumerate(payloads)]
+        return out, metas
+
+    def fetch_group(self, handle):
+        out, metas = handle
+        arr = np.asarray(out)
+        return [
+            {
+                self.feature_type: arr[off : off + t],
+                "fps": np.array(fps),
+                "timestamps_ms": np.array(ts),
+            }
+            for off, t, fps, ts in metas
+        ]

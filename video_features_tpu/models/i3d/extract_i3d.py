@@ -295,7 +295,14 @@ class ExtractI3D(BaseExtractor):
         else:
             samples_ix = np.linspace(1, max(frame_cnt - 1, 1), samples_num).astype(int)
 
-        wanted = read_frames_at_indices(video_path, samples_ix, self.config.decoder)
+        # allow_seek=False: same reasoning as the fix/uni samplers
+        # (io/video.py extract_frames) — CAP_PROP_POS_FRAMES seeks can
+        # land off-by-frames on open-GOP/B-frame streams while passing the
+        # position-readback guard, and the sampled-feature contract must
+        # not ride on that. Sequential decode up to max(index) is exact.
+        wanted = read_frames_at_indices(
+            video_path, samples_ix, self.config.decoder, allow_seek=False
+        )
         # undecodable sampled indices are dropped, exactly like the
         # reference's `if i is not None` filter (ref extract_i3d.py:245-257)
         frames = [wanted[i] for i in samples_ix if i in wanted]
@@ -357,15 +364,26 @@ class ExtractI3D(BaseExtractor):
     # (lag-1): the fetch overlaps the next stack's RAFT/PWC+I3D compute,
     # and at most ~2 stacks' inputs are ever resident in HBM regardless
     # of video length (the fetch is the backpressure).
-    # host-RAM guard: a prepared video is T x 256 x W x 3 float32; the
-    # pipeline keeps decode_workers+2 of them resident. Beyond this cap,
-    # decode moves into the dispatch phase (one video at a time — the old
-    # serial memory profile), same pattern as ResNet's streaming fallback.
-    PIPELINE_MAX_FRAMES = 4096
+    # host-RAM guard: a prepared video is T x 256 x W x 3 float32, and the
+    # pipeline keeps decode_workers+2 of them resident — so the guard is a
+    # BYTE budget across all resident slots, divided down to a per-video
+    # frame cap (advisor r02: a flat 4096-frame cap let ~17 GB accumulate
+    # at the default worker count). Over-cap videos move their decode into
+    # the dispatch phase (one resident at a time — the serial memory
+    # profile), same pattern as ResNet's streaming fallback.
+    PIPELINE_MAX_BYTES = 4 << 30
     # bytes one resized frame costs — the budget unit the cap counts in
     # (min-side 256, ~4:3; disk-flow images are converted to this unit
     # because they prefetch at ORIGINAL resolution)
     _FRAME_BYTES = 256 * 342 * 3 * 4
+
+    @property
+    def PIPELINE_MAX_FRAMES(self) -> int:
+        """Per-video prefetch cap in resized-frame units (floor: one
+        65-frame stack, the smallest unit prepare can hand over)."""
+        return self._prefetch_frame_cap(
+            self.PIPELINE_MAX_BYTES, self._FRAME_BYTES, floor=65
+        )
 
     def _flow_prefetch_cost(self, pairs) -> int:
         """Disk-flow resident cost in resized-frame equivalents: flow

@@ -157,6 +157,38 @@ class ExtractR21D(BaseExtractor):
             outs.append((feats, logits if self.config.show_pred else None, n))
         return path_entry, outs, slices
 
+    # --- cross-video aggregation (--video_batch): valid uint8 window
+    # stacks of N same-resolution videos re-chunk into (N*batch_size)-stack
+    # fused preprocess+forward calls. A typical short video yields 1-4
+    # 16-frame stacks — alone they idle the MXU; fused they fill it. The
+    # agg_key carries (H, W): only same-resolution videos share a compiled
+    # shape. Oversized videos and show_pred keep the individual path.
+    AGG_MAX_STACKS = 128
+
+    def agg_key(self, payload):
+        if self.config.show_pred:
+            return None
+        batches, slices = payload
+        if not slices or len(slices) > self.AGG_MAX_STACKS:
+            return None
+        return batches[0][0].shape  # (batch_size, stack, H, W, 3)
+
+    def dispatch_group(self, device, state, entries, payloads):
+        group = max(int(self.config.video_batch or 1), 1)
+        stacks, totals = [], []  # rows = uint8 window stacks here
+        for batches, slices in payloads:
+            stacks.extend(x[:n] for x, n in batches)
+            totals.append(len(slices))
+        outs = self._dispatch_rows_grouped(state, stacks, self.batch_size * group)
+        return outs, totals
+
+    def fetch_group(self, handle):
+        outs, totals = handle
+        return [
+            {self.feature_type: feats}
+            for feats in self._split_grouped_rows(outs, totals)
+        ]
+
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
         path_entry, outs, slices = handle
         if not slices:

@@ -97,11 +97,18 @@ class ExtractResNet(BaseExtractor):
         return np.stack([imagenet_preprocess(f) for f in batch])
 
     # A prepared video holds preprocessed fp32 224x224 frames (~600 KB
-    # each). Beyond this many frames (~2.5 GB) prepare() stops buffering
-    # and hands the decode back to the device thread as a stream — a
-    # pathological-length video must not OOM the host just because the
-    # pipeline wants to prefetch it (x decode_workers in-flight videos).
-    PIPELINE_MAX_FRAMES = 4096
+    # each); the pipeline keeps decode_workers+2 prepared videos resident,
+    # so the guard is a byte budget split over those slots (advisor r02:
+    # a flat frame cap scaled host RAM with the worker count). Over-cap
+    # videos hand decode back to the device thread as a stream.
+    PIPELINE_MAX_BYTES = 4 << 30
+    _FRAME_BYTES = 3 * 224 * 224 * 4
+
+    @property
+    def PIPELINE_MAX_FRAMES(self) -> int:
+        return self._prefetch_frame_cap(
+            self.PIPELINE_MAX_BYTES, self._FRAME_BYTES, floor=64
+        )
 
     # host half: stream-decode + preprocess into padded static-shape
     # batches (runs on --decode_workers threads under the async pipeline)
@@ -218,3 +225,39 @@ class ExtractResNet(BaseExtractor):
             "fps": np.array(actual_fps),
             "timestamps_ms": np.array(timestamps_ms),
         }
+
+    # --- cross-video aggregation (--video_batch): the valid frames of N
+    # videos re-chunk into (N*batch_size)-row forwards — short videos whose
+    # lone tail batch would waste most of its pad rows share a dispatch.
+    # Large videos (> AGG_MAX_FRAMES valid rows resident while a group
+    # fills) and show_pred (per-video print interleaving) keep the
+    # individual path via agg_key=None.
+    AGG_MAX_FRAMES = 512
+
+    def agg_key(self, payload):
+        if payload[0] == "stream" or self.config.show_pred:
+            return None
+        batches, counts, _, _ = payload
+        if sum(counts) > self.AGG_MAX_FRAMES:
+            return None
+        return batches[0].shape  # (batch_size, 3, 224, 224)
+
+    def dispatch_group(self, device, state, entries, payloads):
+        group = max(int(self.config.video_batch or 1), 1)
+        rows, totals = [], []
+        for batches, counts, _, _ in payloads:
+            rows.extend(x[:n] for x, n in zip(batches, counts))
+            totals.append(sum(counts))
+        outs = self._dispatch_rows_grouped(state, rows, self.batch_size * group)
+        return outs, totals, [(p[2], p[3]) for p in payloads]
+
+    def fetch_group(self, handle):
+        outs, totals, metas = handle
+        return [
+            {
+                self.feature_type: feats,
+                "fps": np.array(fps),
+                "timestamps_ms": np.array(ts),
+            }
+            for feats, (fps, ts) in zip(self._split_grouped_rows(outs, totals), metas)
+        ]

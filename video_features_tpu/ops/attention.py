@@ -36,6 +36,18 @@ HIGHEST = lax.Precision.HIGHEST
 _MASK_VALUE = -1e30
 
 
+def _check_kv_len(kv_len) -> None:
+    """Static-value guard: a concrete kv_len < 1 is a caller bug (the
+    all-masked softmax is mean-of-padding, not zeros — see _finalize).
+    Traced values can't be checked without a device round-trip."""
+    if kv_len is not None and not isinstance(kv_len, jax.core.Tracer):
+        import numpy as _np
+
+        val = _np.asarray(kv_len)
+        if val.size and int(val.min()) < 1:
+            raise ValueError(f"kv_len must be >= 1, got {val.min()}")
+
+
 def _scores(q: jnp.ndarray, k: jnp.ndarray, scale: float) -> jnp.ndarray:
     """(N,H,Lq,d) x (N,H,Lk,d) -> fp32 (N,H,Lq,Lk) scaled scores."""
     s = jnp.einsum("nhqd,nhkd->nhqk", q, k, precision=HIGHEST)
@@ -48,7 +60,13 @@ def attention(
     v: jnp.ndarray,
     kv_len: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Fused core: full score matrix, fp32 softmax, output in q.dtype."""
+    """Fused core: full score matrix, fp32 softmax, output in q.dtype.
+
+    ``kv_len`` (when given) must be >= 1: with every position masked the
+    softmax degenerates to a uniform average of the padding values (see
+    ``_finalize``); the static check below catches concrete zeros, traced
+    values are the caller's contract."""
+    _check_kv_len(kv_len)
     scale = q.shape[-1] ** -0.5
     s = _scores(q, k, scale)
     if kv_len is not None:
@@ -91,7 +109,16 @@ def online_softmax_step(
 
 
 def _finalize(m, l, acc, dtype):
-    # l == 0 only if every KV position was masked; emit zeros, not nan.
+    # Precondition (public entry points document it): >= 1 valid KV
+    # position. With zero valid positions l is NOT 0 — each all-masked
+    # block contributes exp(_MASK_VALUE - _MASK_VALUE) = 1 per position,
+    # so (l, acc) hold count and sum(v) over masked rows and the output
+    # is mean(v-padding), not zeros. Correctness when masked blocks
+    # PRECEDE valid ones relies on the correction factor underflowing:
+    # the first valid block raises m from _MASK_VALUE to a real score,
+    # and corr = exp(_MASK_VALUE - m_new) is exactly 0.0 in fp32, zeroing
+    # the polluted carry (pinned by test_all_masked_prefix_is_cancelled).
+    # The epsilon below only guards the division when l underflows.
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(dtype)
 
@@ -163,7 +190,9 @@ def blockwise_attention(
     KV is right-padded to a multiple of ``block_size`` (padding is masked,
     composing with the caller's own ``kv_len`` mask), then scanned with
     ``online_softmax_step``. Peak live score memory is O(Lq * block_size).
+    ``kv_len`` (when given) must be >= 1 — see ``attention``/``_finalize``.
     """
+    _check_kv_len(kv_len)
     scale = q.shape[-1] ** -0.5
     m, l, acc = accumulate_blockwise(
         q, k, v, init_carry(q), scale, block_size, limit=kv_len

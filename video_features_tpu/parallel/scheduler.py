@@ -98,9 +98,16 @@ def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -
     # (--decode_workers prefetch, extract/base.py::_run_pipelined) has a
     # window of upcoming videos to decode ahead; chunk=1 would starve it.
     # Chunks stay modest so the shared queue still load-balances across
-    # devices; a single device just takes everything in one call.
+    # devices; a single device just takes everything in one call. With
+    # --video_batch aggregation the chunk must cover at least two full
+    # groups, or every chunk boundary would flush a padded partial group.
     workers_per_device = int(getattr(extractor.config, "decode_workers", 0) or 0)
-    chunk_size = n if len(devices) == 1 else max(1, 2 * (workers_per_device + 1))
+    video_batch = int(getattr(extractor.config, "video_batch", 1) or 1)
+    chunk_size = (
+        n
+        if len(devices) == 1
+        else max(1, 2 * (workers_per_device + 1), 2 * video_batch)
+    )
 
     def worker(device) -> None:
         # Build (and compile) this device's model once, up front.
