@@ -315,6 +315,86 @@ def bench_clip_device_only() -> dict:
     return out
 
 
+def bench_i3d_device_only() -> dict:
+    """Chip-only throughput for the north-star deep pipeline: one fused
+    (RAFT flow -> quantize -> I3D) + (crop -> I3D) step on a pre-staged
+    65-frame 256x256 stack, K steps chained in a scan (no decode/tunnel
+    in the timed loop), with XLA's FLOP count -> MFU. Pairs with
+    bench_clip_device_only: together they bound how much of the end-to-end
+    gap is host pipeline vs chip compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.i3d.extract_i3d import center_crop
+    from video_features_tpu.models.i3d.model import build as i3d_build
+    from video_features_tpu.models.i3d.model import init_params as i3d_init
+    from video_features_tpu.models.raft.model import build as raft_build
+    from video_features_tpu.models.raft.model import init_params as raft_init
+    from video_features_tpu.ops.preprocess import flow_to_uint8, scale_to_1_1
+
+    if jax.default_backend() != "tpu":
+        return {}
+    S, H, W, K = 65, 256, 256, 4
+    raft = raft_build()
+    i3d = i3d_build()
+    p_raft = jax.device_put(raft_init())
+    p_rgb = jax.device_put(i3d_init("rgb"))
+    p_flow = jax.device_put(i3d_init("flow"))
+    stack = jax.device_put(
+        jnp.asarray(
+            np.random.RandomState(0).randint(0, 255, (S, H, W, 3)).astype(np.float32)
+        )
+    )
+
+    def step(p_raft, p_rgb, p_flow, stack):
+        flow = raft.apply({"params": p_raft}, stack)  # (S-1, H, W, 2)
+        f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
+        flow_feats, _ = i3d.apply({"params": p_flow}, f[None])
+        rgb = scale_to_1_1(center_crop(stack[:-1]))
+        rgb_feats, _ = i3d.apply({"params": p_rgb}, rgb[None])
+        return flow_feats, rgb_feats
+
+    try:
+        ca = (
+            jax.jit(step)
+            .lower(p_raft, p_rgb, p_flow, stack)
+            .compile()
+            .cost_analysis()
+        )
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        flops = None
+
+    @jax.jit
+    def loop(p_raft, p_rgb, p_flow, stack):
+        def body(carry, _):
+            acc, stack = carry
+            ff, rf = step(p_raft, p_rgb, p_flow, stack)
+            return (acc + jnp.sum(ff) + jnp.sum(rf), jnp.roll(stack, 1, 0)), None
+
+        (acc, _), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), stack), None, length=K
+        )
+        return acc
+
+    float(loop(p_raft, p_rgb, p_flow, stack))  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(loop(p_raft, p_rgb, p_flow, stack))
+        best = min(best, time.perf_counter() - t0)
+    sps = K / best
+    out = {"i3d_raft_device_only_sps": round(sps, 3)}
+    if flops:
+        out["i3d_raft_flops_per_stack"] = round(flops / 1e9, 1)  # GFLOP
+        out["i3d_raft_mfu_fp32_of_bf16_peak"] = round(
+            sps * flops / V5E_BF16_PEAK_FLOPS, 4
+        )
+    return out
+
+
 def _probe_backend(timeout_s: float = 180.0) -> None:
     """Fail fast if the TPU backend is unreachable. The axon tunnel's
     compile helper can die (observed 2026-07-30), after which
@@ -375,8 +455,12 @@ def main() -> None:
         )
         # headline: --video_batch 8 (cross-video aggregation, the shipped
         # fast path); the unaggregated r01/r02-comparable number ships in
-        # extra.clip_solo_* alongside
-        agg = bench_clip(n_videos, clip_video, tmp, video_batch=8)
+        # extra.clip_solo_* alongside. Group size never exceeds the video
+        # count: a chronically-partial group pads to the full shape and
+        # would burn that compute for nothing.
+        agg = bench_clip(
+            n_videos, clip_video, tmp, video_batch=min(8, max(n_videos, 1))
+        )
         clip_vps = agg["best"]
         extra["clip_agg_median_vps"] = agg["median"]
         extra["clip_agg_passes"] = agg["passes"]
@@ -387,7 +471,11 @@ def main() -> None:
         if os.environ.get("BENCH_BF16") == "1":
             # --dtype bfloat16 variant (opt-in: costs a second XLA compile)
             extra["clip_bf16_vps"] = bench_clip(
-                n_videos, clip_video, tmp, dtype="bfloat16", video_batch=8
+                n_videos,
+                clip_video,
+                tmp,
+                dtype="bfloat16",
+                video_batch=min(8, max(n_videos, 1)),
             )["best"]
         if os.environ.get("BENCH_SKIP_I3D") != "1":
             i3d = bench_i3d_raft(i3d_video, tmp)
@@ -395,6 +483,8 @@ def main() -> None:
             extra["i3d_raft_median_vps"] = i3d["median"]
             extra["i3d_raft_passes"] = i3d["passes"]
         extra.update(bench_clip_device_only())
+        if os.environ.get("BENCH_SKIP_I3D") != "1":
+            extra.update(bench_i3d_device_only())
         extra.update(bench_pallas_corr())
         if os.environ.get("BENCH_FLASH") == "1":
             # opt-in: the L=4096 flash-attention Mosaic compile has been

@@ -215,6 +215,32 @@ def test_clip_agg_key_declines_oversized(four_videos, tmp_path):
     assert ex.agg_key(payload) is None
 
 
+def test_clip_aggregation_on_mesh_matches_queue(four_videos, tmp_path):
+    """--video_batch composes with --sharding mesh: the fused (N*bucket)
+    batch shards over 'data' (pad_batch_for rounds it up), features match
+    the single-device aggregated run."""
+    import jax
+
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    solo = ExtractCLIP(
+        _clip_cfg(four_videos[:3], tmp_path, video_batch=3), external_call=True
+    )()
+    mesh = make_mesh(jax.devices(), model=1)
+    ex = ExtractCLIP(
+        _clip_cfg(four_videos[:3], tmp_path, video_batch=3, sharding="mesh"),
+        external_call=True,
+    )
+    fused = ex(device=mesh)
+    assert len(fused) == 3
+    for s, f in zip(solo, fused):
+        # pure-DP mesh: same math, only placement differs
+        np.testing.assert_allclose(
+            f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+
+
 def test_base_extractor_declines_aggregation_by_default(four_videos, tmp_path):
     """Extractors without dispatch_group ignore --video_batch (no crash)."""
     from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish

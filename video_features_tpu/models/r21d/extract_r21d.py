@@ -163,15 +163,22 @@ class ExtractR21D(BaseExtractor):
     # 16-frame stacks — alone they idle the MXU; fused they fill it. The
     # agg_key carries (H, W): only same-resolution videos share a compiled
     # shape. Oversized videos and show_pred keep the individual path.
-    AGG_MAX_STACKS = 128
+    # The cap is BYTES, not stack count: R21D stacks stay at ORIGINAL
+    # resolution until the on-device resize, so a stack count that is
+    # harmless at 240p is gigabytes at 1080p — and up to N-1 payloads per
+    # key park host-side while a group fills (code-review r03).
+    AGG_MAX_BYTES = 256 << 20
 
     def agg_key(self, payload):
         if self.config.show_pred:
             return None
         batches, slices = payload
-        if not slices or len(slices) > self.AGG_MAX_STACKS:
+        if not slices:
             return None
-        return batches[0][0].shape  # (batch_size, stack, H, W, 3)
+        shape = batches[0][0].shape  # (batch_size, stack, H, W, 3)
+        if len(slices) * int(np.prod(shape[1:])) > self.AGG_MAX_BYTES:
+            return None
+        return shape
 
     def dispatch_group(self, device, state, entries, payloads):
         group = max(int(self.config.video_batch or 1), 1)
