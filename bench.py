@@ -240,6 +240,47 @@ def bench_flash_attention() -> dict:
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
+def _time_device_only(step_fn, args, k: int):
+    """Shared chip-only timing harness: XLA's FLOP count for one compiled
+    ``step_fn(*args)``, then K calls chained in a jitted scan (inputs roll
+    so XLA can't hoist the body), best-of-3 with a real result fetch.
+    Returns (flops_per_step or None, best_seconds_per_k_steps)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        ca = jax.jit(step_fn).lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        flops = None
+
+    @jax.jit
+    def loop(*args):
+        def body(carry, _):
+            acc, x = carry
+            outs = step_fn(*args[:-1], x)
+            total = sum(
+                jnp.sum(o.astype(jnp.float32))
+                for o in (outs if isinstance(outs, (tuple, list)) else [outs])
+            )
+            return (acc + total, jnp.roll(x, 1, 0)), None
+
+        (acc, _), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), args[-1]), None, length=k
+        )
+        return acc
+
+    float(loop(*args))  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(loop(*args))
+        best = min(best, time.perf_counter() - t0)
+    return flops, best
+
+
 def bench_clip_device_only() -> dict:
     """Chip-only throughput: a pre-staged 128-image batch through the
     jit-compiled ViT-B/32 tower, K forwards chained in one scan (no
@@ -275,32 +316,7 @@ def bench_clip_device_only() -> dict:
         def forward(p, x, model=model):
             return model.apply({"params": p}, x)
 
-        # XLA's own FLOP count for one compiled forward (honest numerator:
-        # counts what actually runs, not a hand model)
-        try:
-            ca = jax.jit(forward).lower(params, x).compile().cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else {}
-            flops = float(ca.get("flops", 0.0)) or None
-        except Exception:  # noqa: BLE001 - cost analysis is best-effort
-            flops = None
-
-        @jax.jit
-        def loop(p, x, forward=forward):
-            def body(carry, _):
-                acc, x = carry
-                o = forward(p, x)
-                return (acc + jnp.sum(o.astype(jnp.float32)), jnp.roll(x, 1, 0)), None
-
-            (acc, _), _ = jax.lax.scan(body, (jnp.float32(0.0), x), None, length=K)
-            return acc
-
-        float(loop(params, x))  # compile
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(loop(params, x))
-            best = min(best, time.perf_counter() - t0)
+        flops, best = _time_device_only(forward, (params, x), K)
         ips = B * K / best
         out[f"clip_device_only_ips_{tag}"] = round(ips, 1)
         # uni_12 equivalent: what end-to-end videos/s would be if the host
@@ -354,37 +370,7 @@ def bench_i3d_device_only() -> dict:
         rgb_feats, _ = i3d.apply({"params": p_rgb}, rgb[None])
         return flow_feats, rgb_feats
 
-    try:
-        ca = (
-            jax.jit(step)
-            .lower(p_raft, p_rgb, p_flow, stack)
-            .compile()
-            .cost_analysis()
-        )
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) or None
-    except Exception:  # noqa: BLE001 - cost analysis is best-effort
-        flops = None
-
-    @jax.jit
-    def loop(p_raft, p_rgb, p_flow, stack):
-        def body(carry, _):
-            acc, stack = carry
-            ff, rf = step(p_raft, p_rgb, p_flow, stack)
-            return (acc + jnp.sum(ff) + jnp.sum(rf), jnp.roll(stack, 1, 0)), None
-
-        (acc, _), _ = jax.lax.scan(
-            body, (jnp.float32(0.0), stack), None, length=K
-        )
-        return acc
-
-    float(loop(p_raft, p_rgb, p_flow, stack))  # compile
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(loop(p_raft, p_rgb, p_flow, stack))
-        best = min(best, time.perf_counter() - t0)
+    flops, best = _time_device_only(step, (p_raft, p_rgb, p_flow, stack), K)
     sps = K / best
     out = {"i3d_raft_device_only_sps": round(sps, 3)}
     if flops:
@@ -458,9 +444,8 @@ def main() -> None:
         # extra.clip_solo_* alongside. Group size never exceeds the video
         # count: a chronically-partial group pads to the full shape and
         # would burn that compute for nothing.
-        agg = bench_clip(
-            n_videos, clip_video, tmp, video_batch=min(8, max(n_videos, 1))
-        )
+        group = min(8, max(n_videos, 1))
+        agg = bench_clip(n_videos, clip_video, tmp, video_batch=group)
         clip_vps = agg["best"]
         extra["clip_agg_median_vps"] = agg["median"]
         extra["clip_agg_passes"] = agg["passes"]
@@ -471,11 +456,7 @@ def main() -> None:
         if os.environ.get("BENCH_BF16") == "1":
             # --dtype bfloat16 variant (opt-in: costs a second XLA compile)
             extra["clip_bf16_vps"] = bench_clip(
-                n_videos,
-                clip_video,
-                tmp,
-                dtype="bfloat16",
-                video_batch=min(8, max(n_videos, 1)),
+                n_videos, clip_video, tmp, dtype="bfloat16", video_batch=group
             )["best"]
         if os.environ.get("BENCH_SKIP_I3D") != "1":
             i3d = bench_i3d_raft(i3d_video, tmp)

@@ -243,12 +243,13 @@ def test_clip_aggregation_on_mesh_matches_queue(four_videos, tmp_path):
 
 def test_base_extractor_declines_aggregation_by_default(four_videos, tmp_path):
     """Extractors without dispatch_group ignore --video_batch (no crash)."""
-    from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
 
-    ex = ExtractVGGish(
+    ex = ExtractI3D(
         ExtractionConfig(
             allow_random_init=True,
-            feature_type="vggish",
+            feature_type="i3d",
+            flow_type="raft",
             video_paths=list(four_videos[:1]),
             video_batch=4,
             tmp_path=str(tmp_path / "tmp"),
@@ -258,3 +259,42 @@ def test_base_extractor_declines_aggregation_by_default(four_videos, tmp_path):
         external_call=True,
     )
     assert not ex._aggregation_enabled()
+
+
+@pytest.fixture(scope="module")
+def three_wavs(tmp_path_factory):
+    from scipy.io import wavfile
+
+    root = tmp_path_factory.mktemp("agg_audio")
+    sr, paths = 16000, []
+    for i, secs in enumerate((1.5, 2.5, 3.5)):
+        t = np.arange(int(secs * sr)) / sr
+        data = (0.4 * np.sin(2 * np.pi * (300 + 200 * i) * t) * 32767).astype(
+            np.int16
+        )
+        p = str(root / f"a{i}.wav")
+        wavfile.write(p, sr, data)
+        paths.append(p)
+    return paths
+
+
+def test_vggish_aggregated_matches_individual(three_wavs, tmp_path):
+    from video_features_tpu.models.vggish.extract_vggish import ExtractVGGish
+
+    def cfg(vb):
+        return ExtractionConfig(
+            allow_random_init=True,
+            feature_type="vggish",
+            video_paths=list(three_wavs),
+            video_batch=vb,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+
+    solo = ExtractVGGish(cfg(1), external_call=True)()
+    fused = ExtractVGGish(cfg(3), external_call=True)()
+    assert len(solo) == len(fused) == 3
+    for i, (s, f) in enumerate(zip(solo, fused)):
+        assert f["vggish"].shape == (i + 1, 128)  # 1.5/2.5/3.5 s -> 1/2/3
+        np.testing.assert_allclose(f["vggish"], s["vggish"], atol=2e-5, rtol=1e-5)
