@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Chip-work runbook for when the axon relay returns after an outage
+# (BASELINE.md "Round-2 outage note"; rounds 2 AND 3 both lost bench
+# windows to the dead 127.0.0.1:8083 compile helper). Order matters:
+# the cheap probe first, then the BENCH capture (the round's must-have
+# artifact), then the riskier one-off validations — the flash L=4096
+# Mosaic compile has crashed the helper before, so it goes LAST and its
+# result is recorded even if the helper dies right after.
+#
+# Usage: bash scripts/on_tunnel_up.sh  (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 probe =="
+ss -tln | grep -q 8083 || { echo "relay not listening on 8083; abort"; exit 1; }
+timeout 120 python -c "import jax; print('devices:', jax.devices())" || {
+  echo "jax.devices() hung/failed despite the listener; abort"; exit 1; }
+
+echo "== 2/3 bench (both north-star configs) =="
+python bench.py | tee /tmp/bench_r03_local.json
+
+echo "== 3/3 one-off on-chip validations (riskiest compile last) =="
+python scripts/validate_flash_tpu.py \
+  | tee FLASH_TPU_VALIDATION.txt || echo "flash validation failed"
+echo "done — record FLASH_TPU_VALIDATION.txt + bench JSONs in the repo"
