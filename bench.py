@@ -36,8 +36,11 @@ import numpy as np
 # path cannot run here at all). Provenance in BASELINE.md "Measured
 # baselines"; re-measure with BENCH_MEASURE_BASELINE=1.
 MEASURED_BASELINES = {
-    "clip_torch_cpu_vps": 0.91,        # 2026-07-29, host 'vm', 1 CPU core
-    "i3d_raft_torch_cpu_vps": 0.0029,  # ~345 s/video (140 frames, 2 stacks)
+    # 2026-07-30, host 'vm', 1 CPU core, best-of-N (same methodology as
+    # bench.py's passes — advisor r02 symmetry fix; the r02-era numbers
+    # were single-pass: clip 0.91, i3d 0.0029)
+    "clip_torch_cpu_vps": 0.8548,
+    "i3d_raft_torch_cpu_vps": 0.0031,  # ~323 s/video (140 frames, 2 stacks)
 }
 
 

@@ -8,16 +8,21 @@
 # result is recorded even if the helper dies right after.
 #
 # Usage: bash scripts/on_tunnel_up.sh  (from the repo root)
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== 1/3 probe =="
-ss -tln | grep -q 8083 || { echo "relay not listening on 8083; abort"; exit 1; }
+# anchored: a listener on e.g. :18083 must not read as the relay's :8083
+ss -tln | grep -qE '[:.]8083([^0-9]|$)' || {
+  echo "relay not listening on 8083; abort"; exit 1; }
 timeout 120 python -c "import jax; print('devices:', jax.devices())" || {
   echo "jax.devices() hung/failed despite the listener; abort"; exit 1; }
 
 echo "== 2/3 bench (both north-star configs) =="
-python bench.py | tee /tmp/bench_r03_local.json
+python bench.py | tee /tmp/bench_r03_local.json || {
+  echo "bench FAILED (rc=$?) — no numbers captured; NOT proceeding to the"
+  echo "helper-crash-risk flash compile. Re-run when the relay is stable."
+  exit 1; }
 
 echo "== 3/3 one-off on-chip validations (riskiest compile last) =="
 python scripts/validate_flash_tpu.py \

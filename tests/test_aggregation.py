@@ -261,6 +261,53 @@ def test_base_extractor_declines_aggregation_by_default(four_videos, tmp_path):
     assert not ex._aggregation_enabled()
 
 
+def test_aggregation_through_queue_scheduler(four_videos, tmp_path):
+    """--video_batch through parallel_feature_extraction on TWO devices
+    (the virtual-CPU mesh): the multi-device branch's chunk floor
+    (2*video_batch, scheduler.py) is actually exercised — a 1-device run
+    takes the chunk=n shortcut — and each video still lands in its own
+    output file."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+    from video_features_tpu.parallel.devices import resolve_devices
+    from video_features_tpu.parallel.scheduler import parallel_feature_extraction
+
+    cfg = _clip_cfg(
+        four_videos, tmp_path, video_batch=2, on_extraction="save_numpy"
+    ).replace(cpu=False, device_ids=[0, 1])
+    devices = resolve_devices(cfg)
+    assert len(devices) == 2  # conftest pins 8 virtual CPU devices
+    ex = ExtractCLIP(cfg)
+    parallel_feature_extraction(ex, devices)
+    saved = sorted(pathlib.Path(tmp_path / "out").rglob("*.npy"))
+    assert len(saved) == 4
+    solo = ExtractCLIP(
+        _clip_cfg(four_videos, tmp_path / "solo"), external_call=True
+    )()
+    for f, s in zip(saved, solo):  # both sorted by video stem v0..v3
+        np.testing.assert_allclose(
+            np.load(f), s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+
+
+def test_aggregation_with_resume_skips_done(four_videos, tmp_path):
+    """--resume composes with --video_batch: already-extracted videos are
+    skipped before prepare, the remaining ones still group correctly."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(
+        four_videos, tmp_path, video_batch=2, on_extraction="save_numpy"
+    )
+    ExtractCLIP(cfg.replace(video_paths=list(four_videos[:2])))()
+    done = sorted(pathlib.Path(tmp_path / "out").rglob("*.npy"))
+    assert len(done) == 2
+    stamps = {f: f.stat().st_mtime_ns for f in done}
+    ExtractCLIP(cfg.replace(resume=True))()
+    saved = sorted(pathlib.Path(tmp_path / "out").rglob("*.npy"))
+    assert len(saved) == 4
+    for f in done:  # untouched, not recomputed
+        assert f.stat().st_mtime_ns == stamps[f]
+
+
 @pytest.fixture(scope="module")
 def three_wavs(tmp_path_factory):
     from scipy.io import wavfile
