@@ -60,6 +60,11 @@ def _load_measured_baselines() -> dict:
     return MEASURED_BASELINES
 
 
+# the headline CLIP config's sampler — one constant shared by the run and
+# its bench_config record
+CLIP_EXTRACT_METHOD = "uni_12"
+
+
 def _pass_stats(n_items: int, times: list) -> dict:
     """videos/s per pass -> {best, median, passes}. Best is the headline
     (tunnel latency varies minute to minute and only ADDS time — the best
@@ -91,7 +96,7 @@ def bench_clip(
         allow_random_init=True,
         feature_type="CLIP-ViT-B/32",
         video_paths=[video] * n_videos,
-        extract_method="uni_12",
+        extract_method=CLIP_EXTRACT_METHOD,
         dtype=dtype,
         video_batch=video_batch,
         tmp_path=os.path.join(tmp, "t"),
@@ -436,12 +441,10 @@ def main() -> None:
     baselines = _load_measured_baselines()
     extra = {}
     with tempfile.TemporaryDirectory() as tmp:
-        clip_video = synth_video(
-            os.path.join(tmp, "bench.mp4"), n_frames=120, width=640, height=360
-        )
-        i3d_video = synth_video(
-            os.path.join(tmp, "i3d.mp4"), n_frames=140, width=256, height=256
-        )
+        clip_spec = dict(n_frames=120, width=640, height=360)
+        i3d_spec = dict(n_frames=140, width=256, height=256)
+        clip_video = synth_video(os.path.join(tmp, "bench.mp4"), **clip_spec)
+        i3d_video = synth_video(os.path.join(tmp, "i3d.mp4"), **i3d_spec)
         # headline: --video_batch 8 (cross-video aggregation, the shipped
         # fast path); the unaggregated r01/r02-comparable number ships in
         # extra.clip_solo_* alongside. Group size never exceeds the video
@@ -458,9 +461,12 @@ def main() -> None:
         extra["clip_solo_passes"] = solo["passes"]
         if os.environ.get("BENCH_BF16") == "1":
             # --dtype bfloat16 variant (opt-in: costs a second XLA compile)
-            extra["clip_bf16_vps"] = bench_clip(
+            bf16 = bench_clip(
                 n_videos, clip_video, tmp, dtype="bfloat16", video_batch=group
-            )["best"]
+            )
+            extra["clip_bf16_vps"] = bf16["best"]
+            extra["clip_bf16_median_vps"] = bf16["median"]
+            extra["clip_bf16_passes"] = bf16["passes"]
         if os.environ.get("BENCH_SKIP_I3D") != "1":
             i3d = bench_i3d_raft(i3d_video, tmp)
             extra["i3d_raft_vps"] = i3d["best"]
@@ -487,6 +493,15 @@ def main() -> None:
         "reference torch code on this host's CPU (scripts/measure_baseline.py; "
         "BASELINE.md 'Measured baselines')"
     )
+    # reproducibility: the knobs this run actually measured with (derived
+    # from the run's own variables, not restated literals)
+    extra["bench_config"] = {
+        "n_videos": n_videos,
+        "clip_video_batch": group,
+        "clip_extract_method": CLIP_EXTRACT_METHOD,
+        "clip_video_synth": clip_spec,
+        "i3d_video_synth": i3d_spec,
+    }
     print(
         json.dumps(
             {

@@ -308,6 +308,32 @@ def test_aggregation_with_resume_skips_done(four_videos, tmp_path):
         assert f.stat().st_mtime_ns == stamps[f]
 
 
+def test_group_dispatch_failure_reports_every_member(four_videos, tmp_path, capsys):
+    """A fused dispatch that dies (OOM, compile error) fails the WHOLE
+    group — every member video must be reported and counted, and later
+    groups must still run."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(four_videos, tmp_path, video_batch=2)
+    ex = ExtractCLIP(cfg, external_call=True)
+    calls = {"n": 0}
+    real = ExtractCLIP.dispatch_group
+
+    def flaky(self, device, state, entries, payloads):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected fused-dispatch failure")
+        return real(self, device, state, entries, payloads)
+
+    ex.dispatch_group = flaky.__get__(ex)
+    results = ex()
+    # group 1 (2 videos) lost, group 2 (2 videos) delivered
+    assert len(results) == 2
+    out = capsys.readouterr().out
+    assert out.count("An error occurred") == 2
+    assert ex.progress.n == 4  # every video counted exactly once
+
+
 @pytest.fixture(scope="module")
 def three_wavs(tmp_path_factory):
     from scipy.io import wavfile
