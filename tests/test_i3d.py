@@ -232,6 +232,95 @@ def test_i3d_pipelined_outputs_identical(sample_video):
         np.testing.assert_array_equal(s["timestamps_ms"], p["timestamps_ms"])
 
 
+def test_i3d_stack_batching_matches_per_stack(sample_video):
+    """--batch_size B fuses B window stacks per device call (3 stacks at
+    B=2 exercises one full group AND the repeat-padded partial); features
+    must match the per-stack run. rgb pins the plain batched path, pwc
+    pins the vmapped flow-net path."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def run(batch_size, streams, flow_type):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            flow_type=flow_type,
+            streams=streams,
+            video_paths=[sample_video],
+            stack_size=10,
+            step_size=24,
+            batch_size=batch_size,
+            cpu=True,
+        )
+        ex = ExtractI3D(cfg, external_call=True)
+        ex.progress.disable = True
+        (r,) = ex([0])
+        return r
+
+    solo = run(1, ["rgb"], "pwc")
+    fused = run(2, ["rgb"], "pwc")
+    assert solo["rgb"].shape == fused["rgb"].shape == (3, 1024)
+    np.testing.assert_allclose(fused["rgb"], solo["rgb"], atol=1e-5, rtol=1e-5)
+
+    solo_f = run(1, ["flow"], "pwc")
+    fused_f = run(2, ["flow"], "pwc")
+    assert solo_f["flow"].shape == fused_f["flow"].shape == (3, 1024)
+    np.testing.assert_allclose(
+        fused_f["flow"], solo_f["flow"], atol=1e-4, rtol=1e-4
+    )
+
+
+def test_i3d_stack_batching_raft_and_disk_flow(sample_video, tmp_path):
+    """The two remaining batched branches: the RAFT vmap closure and the
+    disk-flow group stacking/zero-padding (each has its own code in
+    dispatch_prepared/_fns_for_shape)."""
+    import cv2
+
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def run(batch_size, flow_type, **extra):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            flow_type=flow_type,
+            streams=["flow"],
+            stack_size=10,
+            step_size=24,
+            batch_size=batch_size,
+            cpu=True,
+            **extra,
+        )
+        ex = ExtractI3D(cfg, external_call=True)
+        ex.progress.disable = True
+        (r,) = ex([0])
+        return r
+
+    # raft: the vmapped sequence view over the group
+    solo = run(1, "raft", video_paths=[sample_video])
+    fused = run(2, "raft", video_paths=[sample_video])
+    assert solo["flow"].shape == fused["flow"].shape == (3, 1024)
+    np.testing.assert_allclose(fused["flow"], solo["flow"], atol=1e-4, rtol=1e-4)
+
+    # disk flow: stems pair by name; group stacking of the JPEG windows
+    flow_dir = tmp_path / "synth"
+    flow_dir.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(60):
+        for axis in ("x", "y"):
+            img = rng.randint(0, 256, size=(256, 300), dtype=np.uint8)
+            cv2.imwrite(str(flow_dir / f"flow_{axis}_{i:05d}.jpg"), img)
+    kw = dict(
+        video_paths=[sample_video], flow_paths=[str(flow_dir)]
+    )
+    solo_d = run(1, "flow", **kw)
+    fused_d = run(2, "flow", **kw)
+    assert solo_d["flow"].shape == fused_d["flow"].shape
+    np.testing.assert_allclose(
+        fused_d["flow"], solo_d["flow"], atol=1e-5, rtol=1e-5
+    )
+
+
 def test_i3d_over_cap_video_defers_decode(sample_video, monkeypatch):
     """Videos whose sampled frame count exceeds PIPELINE_MAX_FRAMES skip
     host prefetch (decode happens in the dispatch phase) but produce

@@ -78,6 +78,32 @@ def center_crop(x: jnp.ndarray, crop: int = CENTRAL_CROP_SIZE) -> jnp.ndarray:
     return x[..., fh : fh + crop, fw : fw + crop, :]
 
 
+# The parity-critical transform chains, defined ONCE on trailing axes so
+# the per-stack (mesh) and stack-batched (single-device) pipelines share
+# them exactly — a fix here reaches both execution modes.
+def rgb_chain(stack_tail: jnp.ndarray) -> jnp.ndarray:
+    """RGB frames -> I3D input (ref extract_i3d.py:178-184)."""
+    return scale_to_1_1(center_crop(stack_tail))
+
+
+def flow_chain(flow: jnp.ndarray) -> jnp.ndarray:
+    """Raw flow -> I3D input: crop the PADDED flow like the reference
+    (ref extract_i3d.py:170-184), clamp/quantize to uint8 levels, scale."""
+    return scale_to_1_1(flow_to_uint8(center_crop(flow)))
+
+
+def disk_flow_chain(flow_imgs: jnp.ndarray) -> jnp.ndarray:
+    """Flow JPEGs already hold the uint8-QUANTIZED flow (the
+    128 + 255/40*f map; what sink save_jpg and denseflow-style tools
+    write), so only the [-1,1] scaling remains. Intentional divergence,
+    documented in PARITY.md: the reference re-applies
+    Clamp(-20,20)+ToUInt8 to the 0..255 pixels (extract_i3d.py:204-220),
+    collapsing nearly every value to 255 — its flow-from-disk features
+    are garbage, and no round-trip with its own flow extractors can
+    work."""
+    return scale_to_1_1(center_crop(flow_imgs))
+
+
 class ExtractI3D(BaseExtractor):
     # --sharding mesh: each stack's FRAME axis shards over 'data' inside
     # the jitted per-stream pipelines (sequence parallelism: GSPMD halo
@@ -91,6 +117,13 @@ class ExtractI3D(BaseExtractor):
         self.flow_type = self.config.flow_type or "pwc"
         self.stack_size = int(self.config.stack_size or DEFAULT_STACK_SIZE)
         self.step_size = int(self.config.step_size or DEFAULT_STEP_SIZE)
+        # --batch_size B: window stacks per fused device call (the
+        # reference's i3d path ignores the flag; here it batches stacks
+        # the way its 2D nets batch frames). The last group repeats its
+        # final stack up to B so XLA keeps one compiled shape; surplus
+        # outputs are sliced off. Mesh runs pin B=1 — there the stack's
+        # FRAME axis is what shards (sequence parallelism).
+        self.stack_batch = max(int(self.config.batch_size or 1), 1)
         self._host_params: Dict[str, object] = {}
 
     def feature_keys(self):
@@ -194,47 +227,93 @@ class ExtractI3D(BaseExtractor):
         fns = {}
 
         if is_mesh(state["device"]):
+            # mesh: per-stack fns, the FRAME axis shards (untouched by
+            # --batch_size stack batching, which is the single-device
+            # throughput knob)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             seq = NamedSharding(state["device"], P("data"))
 
             def shard_seq(stack):
                 return jax.lax.with_sharding_constraint(stack, seq)
-        else:
 
-            def shard_seq(stack):
-                return stack
+            if "rgb" in self.streams:
 
+                @jax.jit
+                def rgb_fn(p, stack):  # (S+1, H, W, 3) raw [0,255] floats
+                    # stack[:-1] in EVERY mode: with pre-extracted flow
+                    # the window is stack_size, so rgb runs on
+                    # stack_size-1 frames — exactly the reference
+                    # (extract_i3d.py:178-179,221-222)
+                    x = rgb_chain(shard_seq(stack)[:-1])
+                    return i3d.apply({"params": p}, x[None])
+
+                fns["rgb"] = rgb_fn
+
+            if "flow" in self.streams and self.flow_type == "raft":
+                raft, (l, r, t, b) = self._raft_and_pad(shape)
+
+                @jax.jit
+                def flow_fn(p_flow, p_i3d, stack):
+                    padded = jnp.pad(
+                        shard_seq(stack), ((0, 0), (t, b), (l, r), (0, 0)),
+                        mode="edge",
+                    )
+                    flow = raft.apply({"params": p_flow}, padded)  # (S, Hp, Wp, 2)
+                    return i3d.apply({"params": p_i3d}, flow_chain(flow)[None])
+
+                fns["flow"] = flow_fn
+            elif "flow" in self.streams and self.flow_type == "pwc":
+                from video_features_tpu.models.pwc.model import build as pwc_build
+
+                pwc = pwc_build()
+
+                @jax.jit
+                def flow_fn(p_flow, p_i3d, stack):
+                    flow = pwc.apply({"params": p_flow}, shard_seq(stack))
+                    return i3d.apply({"params": p_i3d}, flow_chain(flow)[None])
+
+                fns["flow"] = flow_fn
+            elif "flow" in self.streams and self.flow_type == "flow":
+
+                @jax.jit
+                def flow_fn(p_i3d, flow_imgs):  # (S, H', W', 2) as floats
+                    f = disk_flow_chain(shard_seq(flow_imgs))
+                    return i3d.apply({"params": p_i3d}, f[None])
+
+                fns["flow"] = flow_fn
+
+            state["fns"][key] = fns
+            return fns
+
+        # single device: STACK-BATCHED fns — every input carries a leading
+        # (B,) group axis (--batch_size; B=1 keeps the reference's
+        # one-stack-at-a-time math, just with a batch dim). I3D takes the
+        # batch natively; the flow nets consume one SEQUENCE each, so they
+        # vmap over the group. Transform chains are the same module-level
+        # functions the mesh fns use.
         if "rgb" in self.streams:
 
             @jax.jit
-            def rgb_fn(p, stack):  # (S+1, H, W, 3) raw [0,255] floats
-                # stack[:-1] in EVERY mode: with pre-extracted flow the
-                # window is stack_size, so rgb runs on stack_size-1 frames
-                # — exactly the reference (extract_i3d.py:178-179,221-222)
-                x = scale_to_1_1(center_crop(shard_seq(stack)[:-1]))
-                return i3d.apply({"params": p}, x[None])
+            def rgb_fn(p, stacks):  # (B, S+1, H, W, 3) raw [0,255] floats
+                # [:, :-1] in EVERY mode — see the mesh variant's note
+                return i3d.apply({"params": p}, rgb_chain(stacks[:, :-1]))
 
             fns["rgb"] = rgb_fn
 
         if "flow" in self.streams and self.flow_type == "raft":
-            from video_features_tpu.models.raft.extract_raft import InputPadder
-            from video_features_tpu.models.raft.model import build as raft_build
-
-            raft = raft_build()
-            padder = InputPadder(shape)
-            l, r, t, b = padder._pad
+            raft, (l, r, t, b) = self._raft_and_pad(shape)
 
             @jax.jit
-            def flow_fn(p_flow, p_i3d, stack):
+            def flow_fn(p_flow, p_i3d, stacks):  # (B, S+1, H, W, 3)
                 padded = jnp.pad(
-                    shard_seq(stack), ((0, 0), (t, b), (l, r), (0, 0)),
+                    stacks, ((0, 0), (0, 0), (t, b), (l, r), (0, 0)),
                     mode="edge",
                 )
-                flow = raft.apply({"params": p_flow}, padded)  # (S, Hp, Wp, 2)
-                # the reference crops the PADDED flow (extract_i3d.py:170-184)
-                f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
-                return i3d.apply({"params": p_i3d}, f[None])
+                flow = jax.vmap(lambda s: raft.apply({"params": p_flow}, s))(
+                    padded
+                )  # (B, S, Hp, Wp, 2)
+                return i3d.apply({"params": p_i3d}, flow_chain(flow))
 
             fns["flow"] = flow_fn
         elif "flow" in self.streams and self.flow_type == "pwc":
@@ -243,31 +322,30 @@ class ExtractI3D(BaseExtractor):
             pwc = pwc_build()
 
             @jax.jit
-            def flow_fn(p_flow, p_i3d, stack):
-                flow = pwc.apply({"params": p_flow}, shard_seq(stack))  # (S, H, W, 2)
-                f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
-                return i3d.apply({"params": p_i3d}, f[None])
+            def flow_fn(p_flow, p_i3d, stacks):  # (B, S+1, H, W, 3)
+                flow = jax.vmap(lambda s: pwc.apply({"params": p_flow}, s))(
+                    stacks
+                )  # (B, S, H, W, 2)
+                return i3d.apply({"params": p_i3d}, flow_chain(flow))
 
             fns["flow"] = flow_fn
         elif "flow" in self.streams and self.flow_type == "flow":
 
             @jax.jit
-            def flow_fn(p_i3d, flow_imgs):  # (S, H', W', 2) uint8 as floats
-                # Flow JPEGs already hold the uint8-QUANTIZED flow (the
-                # 128 + 255/40·f map; what sink save_jpg and denseflow-style
-                # tools write), so only the [-1,1] scaling remains.
-                # Intentional divergence, documented in PARITY.md: the
-                # reference re-applies Clamp(-20,20)+ToUInt8 to the 0..255
-                # pixels (extract_i3d.py:204-220), collapsing nearly every
-                # value to 255 — its flow-from-disk features are garbage,
-                # and no round-trip with its own flow extractors can work.
-                f = scale_to_1_1(center_crop(shard_seq(flow_imgs)))
-                return i3d.apply({"params": p_i3d}, f[None])
+            def flow_fn(p_i3d, flow_imgs):  # (B, S, H', W', 2) as floats
+                return i3d.apply({"params": p_i3d}, disk_flow_chain(flow_imgs))
 
             fns["flow"] = flow_fn
 
         state["fns"][key] = fns
         return fns
+
+    @staticmethod
+    def _raft_and_pad(shape):
+        from video_features_tpu.models.raft.extract_raft import InputPadder
+        from video_features_tpu.models.raft.model import build as raft_build
+
+        return raft_build(), InputPadder(shape)._pad
 
     # --- decode ------------------------------------------------------------
     def _sampled_count(self, meta) -> int:
@@ -438,7 +516,7 @@ class ExtractI3D(BaseExtractor):
     def dispatch_prepared(self, device, state, path_entry, payload):
         from jax.sharding import PartitionSpec as P
 
-        from video_features_tpu.parallel.sharding import place_batch
+        from video_features_tpu.parallel.sharding import is_mesh, place_batch
 
         decoded, flow_imgs, from_disk, meta = payload
         if decoded is None:  # over the prefetch cap: load here, held once
@@ -454,23 +532,50 @@ class ExtractI3D(BaseExtractor):
         # with disk flow the reference zips frames with flow pairs, so the
         # windowed extent truncates to the shorter (ref extract_i3d.py:266)
         extent = min(len(frames), len(flow_imgs)) if from_disk else len(frames)
+        mesh = is_mesh(state["device"])
+        group = 1 if mesh else self.stack_batch
+        slices = form_slices(extent, window, self.step_size)
         pending = None
-        for stack_counter, (start, end) in enumerate(
-            form_slices(extent, window, self.step_size)
-        ):
-            stack = np.stack(frames[start:end])
-            x = place_batch(stack, state["device"], spec=P())
+        for g0 in range(0, len(slices), group):
+            chunk = slices[g0 : g0 + group]
+            n_valid = len(chunk)
+            if mesh:  # per-stack, frame axis shards (sequence parallel)
+                start, end = chunk[0]
+                x = place_batch(
+                    np.stack(frames[start:end]), state["device"], spec=P()
+                )
+                fl = (
+                    place_batch(flow_imgs[start:end], state["device"], spec=P())
+                    if from_disk
+                    else None
+                )
+            else:  # stack-batched: the last group zero-pads to the full
+                # shape (ops/window.py pad_batch, the shared static-shape
+                # idiom); surplus outputs are sliced off at fetch
+                from video_features_tpu.ops.window import pad_batch
+
+                x = place_batch(
+                    pad_batch(
+                        np.stack([np.stack(frames[s:e]) for s, e in chunk]), group
+                    ),
+                    state["device"],
+                )
+                fl = (
+                    place_batch(
+                        pad_batch(
+                            np.stack([flow_imgs[s:e] for s, e in chunk]), group
+                        ),
+                        state["device"],
+                    )
+                    if from_disk
+                    else None
+                )
             outs = []
             for stream in self.streams:
                 if stream == "rgb":
                     f, logits = fns["rgb"](state["params"]["rgb"], x)
                 elif from_disk:
-                    f, logits = fns["flow"](
-                        state["params"]["flow"],
-                        place_batch(
-                            flow_imgs[start:end], state["device"], spec=P()
-                        ),
-                    )
+                    f, logits = fns["flow"](state["params"]["flow"], fl)
                 else:
                     f, logits = fns["flow"](
                         state["params"][self.flow_type], state["params"]["flow"], x
@@ -479,16 +584,18 @@ class ExtractI3D(BaseExtractor):
                     (stream, f, logits if self.config.show_pred else None)
                 )
             if pending is not None:
-                self._fetch_stack(pending, feats, preds)  # overlaps this stack
-            pending = (stack_counter, outs)
+                self._fetch_stack(pending, feats, preds)  # overlaps this group
+            pending = (g0, n_valid, outs)
         return feats, preds, pending, video_path_of(path_entry), fps, timestamps_ms
 
     def _fetch_stack(self, pending, feats, preds) -> None:
-        stack_idx, outs = pending
+        base_idx, n_valid, outs = pending
         for stream, f, logits in outs:
-            feats[stream].append(np.asarray(f)[0])
+            feats[stream].append(np.asarray(f)[:n_valid])
             if logits is not None:
-                preds.append((stack_idx, stream, np.asarray(logits)[0]))
+                arr = np.asarray(logits)[:n_valid]
+                for j in range(n_valid):
+                    preds.append((base_idx + j, stream, arr[j]))
 
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
         feats, preds, pending, video_path, fps, timestamps_ms = handle
@@ -498,7 +605,11 @@ class ExtractI3D(BaseExtractor):
             print(f"{video_path} @ stack {stack_idx} ({stream} stream)")
             show_predictions_on_dataset(logits, "kinetics")
         out: Dict[str, np.ndarray] = {
-            s: np.array(feats[s], dtype=np.float32).reshape(-1, 1024)
+            s: (
+                np.concatenate(feats[s], axis=0).astype(np.float32)
+                if feats[s]
+                else np.zeros((0, 1024), np.float32)
+            )
             for s in self.streams
         }
         out["fps"] = np.array(fps)
