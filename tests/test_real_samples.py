@@ -5,8 +5,9 @@ mp4v-encoded CFR streams; the reference ships two real H.264 UCF101 clips
 (ref sample/v_GGSY1Qvo990.mp4, sample/sample_video_paths.txt, used by
 run.sh:1-15 and every docs page) with B-frames, audio tracks, and real
 encoder quirks. These tests pin: both decode backends return bit-identical
-frames on real H.264, and the CLIP/ResNet/VGGish contracts hold end to
-end. Skipped wholesale when the reference mount is absent.
+frames on real H.264, and the CLIP, ResNet, VGGish, I3D (rgb,
+stack-batched), R(2+1)D, and PWC-flow contracts hold end to end. Skipped
+wholesale when the reference mount is absent.
 """
 
 import os
@@ -123,6 +124,74 @@ def test_vggish_contract_on_real_sample(tmp_path):
     feats = r["vggish"]
     assert feats.ndim == 2 and feats.shape[1] == 128
     assert feats.shape[0] >= 1 and np.isfinite(feats).all()
+
+
+def test_i3d_rgb_contract_on_real_sample(tmp_path):
+    """I3D rgb stream on the real 355-frame clip: small stacks on a wide
+    step keep the CPU cost low while exercising the real decode + window
+    grid end to end."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="i3d",
+        streams=["rgb"],  # rgb-only: no flow model is built or needed
+        stack_size=10,
+        step_size=64,
+        batch_size=2,  # the stack-batched path on a real stream
+        video_paths=[SAMPLES[0]],
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    (r,) = ExtractI3D(cfg, external_call=True)([0])
+    feats = r["rgb"]
+    # 355 frames, 11-frame windows, step 64 -> 6 stacks
+    assert feats.shape == (6, 1024) and np.isfinite(feats).all()
+
+
+def test_r21d_contract_on_real_sample(tmp_path):
+    """R(2+1)D clip-level contract on the real stream (wide step keeps
+    the 3D-conv cost down): (S, 512)."""
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="r21d_rgb",
+        stack_size=16,
+        step_size=160,
+        batch_size=2,
+        video_paths=[SAMPLES[1]],
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    (r,) = ExtractR21D(cfg, external_call=True)([0])
+    feats = r["r21d_rgb"]
+    assert feats.ndim == 2 and feats.shape[1] == 512 and feats.shape[0] >= 1
+    assert np.isfinite(feats).all()
+
+
+def test_pwc_flow_contract_on_real_sample(tmp_path):
+    """PWC flow on the real stream at ~1 fps: per-pair 2-channel flow at
+    input resolution (BASELINE.md flow contract)."""
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="pwc",
+        extraction_fps=1.0,
+        batch_size=8,
+        video_paths=[SAMPLES[0]],
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    (r,) = ExtractPWC(cfg, external_call=True)([0])
+    flow = r["pwc"]
+    assert flow.ndim == 4 and flow.shape[1] == 2  # (T-1, 2, H, W)
+    assert flow.shape[0] == len(r["timestamps_ms"]) - 1
+    assert np.isfinite(flow).all()
 
 
 def test_sample_video_paths_txt_round_trip(tmp_path):
