@@ -1,0 +1,39 @@
+"""bench.py's pure helpers: the {best, median, passes} contract the
+round-over-round BENCH artifacts depend on (VERDICT r02 weak #7 asked
+for medians + raw passes precisely so deltas can't be flattered)."""
+
+import numpy as np
+
+from bench import _pass_stats, _time_device_only
+
+
+def test_pass_stats_odd():
+    s = _pass_stats(4, [2.0, 1.0, 4.0])  # 2, 4, 1 videos/s
+    assert s["best"] == 4.0
+    assert s["median"] == 2.0
+    assert s["passes"] == [1.0, 2.0, 4.0]  # sorted ascending
+
+
+def test_pass_stats_even():
+    s = _pass_stats(6, [1.0, 2.0, 3.0, 6.0])  # 6, 3, 2, 1 videos/s
+    assert s["best"] == 6.0
+    assert s["median"] == 2.5  # mean of the middle two
+    assert s["passes"] == [1.0, 2.0, 3.0, 6.0]
+
+
+def test_time_device_only_counts_flops():
+    import jax.numpy as jnp
+
+    def step(p, x):
+        return x @ p
+
+    p = jnp.asarray(np.eye(16, dtype=np.float32))
+    x = jnp.asarray(np.ones((4, 16), dtype=np.float32))
+    flops, best = _time_device_only(step, (p, x), 3)
+    assert best > 0
+    # cost_analysis is best-effort (the helper returns None when the
+    # backend reports nothing); when present it must be in the right
+    # ballpark of the matmul's 2*M*N*K — not an exact-count pin, which
+    # would encode an XLA implementation detail
+    if flops is not None:
+        assert 0.5 * 2 * 4 * 16 * 16 <= flops <= 4 * 2 * 4 * 16 * 16
