@@ -308,6 +308,27 @@ def test_aggregation_with_resume_skips_done(four_videos, tmp_path):
         assert f.stat().st_mtime_ns == stamps[f]
 
 
+def test_clip_bf16_aggregated_matches_bf16_solo(four_videos, tmp_path):
+    """--dtype bfloat16 composes with --video_batch: the fused bf16 batch
+    must match per-video bf16 dispatch (same dtype both sides, so only
+    batch-shape reduction order differs — tight budget)."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    solo = ExtractCLIP(
+        _clip_cfg(four_videos[:3], tmp_path, dtype="bfloat16"),
+        external_call=True,
+    )()
+    fused = ExtractCLIP(
+        _clip_cfg(four_videos[:3], tmp_path, dtype="bfloat16", video_batch=3),
+        external_call=True,
+    )()
+    assert len(solo) == len(fused) == 3
+    for s, f in zip(solo, fused):
+        np.testing.assert_allclose(
+            f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=1e-3, rtol=1e-2
+        )
+
+
 def test_group_dispatch_failure_reports_every_member(four_videos, tmp_path, capsys):
     """A fused dispatch that dies (OOM, compile error) fails the WHOLE
     group — every member video must be reported and counted, and later
