@@ -19,7 +19,7 @@ timeout 120 python -c "import jax; print('devices:', jax.devices())" || {
   echo "jax.devices() hung/failed despite the listener; abort"; exit 1; }
 
 echo "== 2/3 bench (both north-star configs) =="
-python bench.py | tee /tmp/bench_r03_local.json || {
+python bench.py | tee /tmp/bench_r04_local.json || {
   echo "bench FAILED (rc=$?) — no numbers captured; NOT proceeding to the"
   echo "helper-crash-risk flash compile. Re-run when the relay is stable."
   exit 1; }

@@ -329,10 +329,11 @@ def test_clip_bf16_aggregated_matches_bf16_solo(four_videos, tmp_path):
         )
 
 
-def test_group_dispatch_failure_reports_every_member(four_videos, tmp_path, capsys):
-    """A fused dispatch that dies (OOM, compile error) fails the WHOLE
-    group — every member video must be reported and counted, and later
-    groups must still run."""
+def test_group_dispatch_failure_falls_back_to_solo(four_videos, tmp_path, capsys):
+    """A fused dispatch that dies (OOM, compile error) must NOT discard
+    the group: every member is re-run through the individual path, so all
+    videos still deliver features identical to a solo run (advisor r03
+    medium: one bad interaction was costing up to N-1 good videos)."""
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
 
     cfg = _clip_cfg(four_videos, tmp_path, video_batch=2)
@@ -348,11 +349,70 @@ def test_group_dispatch_failure_reports_every_member(four_videos, tmp_path, caps
 
     ex.dispatch_group = flaky.__get__(ex)
     results = ex()
-    # group 1 (2 videos) lost, group 2 (2 videos) delivered
-    assert len(results) == 2
+    # group 1's members recovered via the solo path, group 2 fused
+    assert len(results) == 4
     out = capsys.readouterr().out
-    assert out.count("An error occurred") == 2
+    assert "An error occurred" not in out
+    assert "falling back to per-video dispatch" in out  # fused failure logged
     assert ex.progress.n == 4  # every video counted exactly once
+    solo = ExtractCLIP(_clip_cfg(four_videos, tmp_path), external_call=True)()
+    for s, f in zip(solo, results):
+        np.testing.assert_allclose(
+            f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+
+
+def test_group_fetch_failure_falls_back_to_solo(four_videos, tmp_path, capsys):
+    """Same contract on the blocking half: a fused fetch_group that dies
+    re-dispatches each member individually (payloads are kept host-side
+    until the group's fetch succeeds, exactly for this)."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(four_videos, tmp_path, video_batch=2)
+    ex = ExtractCLIP(cfg, external_call=True)
+    calls = {"n": 0}
+    real = ExtractCLIP.fetch_group
+
+    def flaky(self, handle):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected fused-fetch failure")
+        return real(self, handle)
+
+    ex.fetch_group = flaky.__get__(ex)
+    results = ex()
+    assert len(results) == 4
+    out = capsys.readouterr().out
+    assert "An error occurred" not in out
+    assert "falling back to per-video dispatch" in out
+    assert ex.progress.n == 4
+
+
+def test_group_fallback_isolates_truly_bad_member(four_videos, tmp_path, capsys):
+    """When the fused dispatch fails AND one member really is poisoned
+    (its solo dispatch fails too), only that member is reported — the
+    rest of the group still delivers."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(four_videos[:2], tmp_path, video_batch=2)
+    ex = ExtractCLIP(cfg, external_call=True)
+    real_extract = ExtractCLIP.extract_prepared
+
+    def group_dies(self, device, state, entries, payloads):
+        raise RuntimeError("injected fused-dispatch failure")
+
+    def solo_poisoned(self, device, state, entry, payload):
+        if entry == four_videos[0]:
+            raise RuntimeError("poisoned member")
+        return real_extract(self, device, state, entry, payload)
+
+    ex.dispatch_group = group_dies.__get__(ex)
+    ex.extract_prepared = solo_poisoned.__get__(ex)
+    results = ex()
+    assert len(results) == 1  # the good member survived
+    out = capsys.readouterr().out
+    assert out.count("An error occurred") == 1
+    assert ex.progress.n == 2
 
 
 @pytest.fixture(scope="module")

@@ -252,19 +252,56 @@ class BaseExtractor:
         agg = self._aggregation_enabled()
         group_size = max(int(self.config.video_batch or 1), 1)
         groups: Dict[Any, list] = {}  # agg_key -> [(pos, entry, payload)]
-        inflight: deque = deque()  # ([(pos, entry), ...], handle, grouped)
+        # ([(pos, entry), ...], handle, grouped, payloads-or-None); grouped
+        # entries keep their payloads host-resident until fetch succeeds so
+        # a fused failure can fall back to the solo path (inflight depth is
+        # <=2, so at most two groups' payloads stay pinned)
+        inflight: deque = deque()
+
+        def run_solo(pos, entry, payload):
+            """The individual device path for one prepared video (shared
+            by the non-split dispatch branch and the group fallback)."""
+
+            def one():
+                with self.timer.stage("device"):
+                    feats_dict = self.extract_prepared(device, state, entry, payload)
+                self._sink_or_collect(feats_dict, entry, results, pos)
+
+            self._isolate(entry, one)
+
+        def solo_fallback(items, phase):  # items: [(pos, entry, payload)]
+            """A fused dispatch/fetch died (OOM, one bad interaction):
+            recover per-video isolation by re-running every member through
+            the individual ``extract_prepared`` path, so at most the truly
+            bad video is lost — matching the non-aggregated contract
+            (advisor r03 medium). The fused failure itself is logged so a
+            persistent group-path regression stays visible even when every
+            member recovers."""
+            print(
+                f"Fused --video_batch {phase} failed for a group of "
+                f"{len(items)}; falling back to per-video dispatch:"
+            )
+            traceback.print_exc()
+            for pos, e, p in items:
+                run_solo(pos, e, p)
 
         def fetch_one():
-            slots, handle, grouped = inflight.popleft()
+            slots, handle, grouped, payloads = inflight.popleft()
             if grouped:
                 try:
                     with self.timer.stage("device"):
                         dicts = self.fetch_group(handle)
                 except KeyboardInterrupt:
                     raise
-                except Exception:  # noqa: BLE001 - the fused fetch fails together
-                    for _, e in slots:
-                        self._report_video_error(e)
+                except Exception:  # noqa: BLE001 - fused fetch fails together
+                    # free the dead group's device buffers before the solo
+                    # re-runs, or they contend for the HBM that may have
+                    # caused the failure
+                    del handle
+                    solo_fallback(
+                        [(pos, e, p) for (pos, e), p in zip(slots, payloads)],
+                        "fetch",
+                    )
                     return
                 for (pos, e), d in zip(slots, dicts):
                     self._isolate(e, self._sink_or_collect, d, e, results, pos)
@@ -280,18 +317,18 @@ class BaseExtractor:
 
         def dispatch_group_now(items):  # items: [(pos, entry, payload)]
             entries = [e for _, e, _ in items]
+            payloads = [p for _, _, p in items]
             try:
                 with self.timer.stage("device"):
-                    handle = self.dispatch_group(
-                        device, state, entries, [p for _, _, p in items]
-                    )
+                    handle = self.dispatch_group(device, state, entries, payloads)
             except KeyboardInterrupt:
                 raise
-            except Exception:  # noqa: BLE001 - the fused dispatch fails together
-                for e in entries:
-                    self._report_video_error(e)
+            except Exception:  # noqa: BLE001 - fused dispatch fails together
+                solo_fallback(items, "dispatch")
                 return
-            inflight.append(([(pos, e) for pos, e, _ in items], handle, True))
+            inflight.append(
+                ([(pos, e) for pos, e, _ in items], handle, True, payloads)
+            )
             if len(inflight) > 1:
                 fetch_one()
 
@@ -304,6 +341,7 @@ class BaseExtractor:
                                 [(pos, entry)],
                                 self.dispatch_prepared(device, state, entry, payload),
                                 False,
+                                None,
                             )
                         )
                 except KeyboardInterrupt:
@@ -314,12 +352,7 @@ class BaseExtractor:
                     fetch_one()
                 return
 
-            def one():
-                with self.timer.stage("device"):
-                    feats_dict = self.extract_prepared(device, state, entry, payload)
-                self._sink_or_collect(feats_dict, entry, results, pos)
-
-            self._isolate(entry, one)
+            run_solo(pos, entry, payload)
 
         def consume_one():
             pos, idx, fut = pending.popleft()
