@@ -16,7 +16,10 @@ DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if ss -tln | grep -qE '[:.]8083([^0-9]|$)'; then
     echo "$(date -u +%FT%TZ) UP — relay listening, starting capture" >> "$PROBE_LOG"
-    bash scripts/on_tunnel_up.sh > /tmp/on_tunnel_up_r04.log 2>&1
+    # append, never truncate: each attempt's failure output is the audit
+    # trail VERDICT r3 asked for — a later attempt must not wipe it
+    echo "=== capture attempt $(date -u +%FT%TZ) ===" >> /tmp/on_tunnel_up_r04.log
+    bash scripts/on_tunnel_up.sh >> /tmp/on_tunnel_up_r04.log 2>&1
     rc=$?
     echo "$(date -u +%FT%TZ) capture finished rc=$rc" >> "$PROBE_LOG"
     if [ $rc -eq 0 ]; then

@@ -234,7 +234,7 @@ def test_i3d_pipelined_outputs_identical(sample_video):
 
 def test_i3d_stack_batching_matches_per_stack(sample_video):
     """--batch_size B fuses B window stacks per device call (3 stacks at
-    B=2 exercises one full group AND the repeat-padded partial); features
+    B=2 exercises one full group AND the zero-padded partial); features
     must match the per-stack run. rgb pins the plain batched path, pwc
     pins the vmapped flow-net path."""
     from video_features_tpu.config import ExtractionConfig

@@ -269,38 +269,46 @@ class BaseExtractor:
 
             self._isolate(entry, one)
 
-        def solo_fallback(items, phase):  # items: [(pos, entry, payload)]
+        def solo_fallback(items, phase, fused_err):
             """A fused dispatch/fetch died (OOM, one bad interaction):
             recover per-video isolation by re-running every member through
             the individual ``extract_prepared`` path, so at most the truly
             bad video is lost — matching the non-aggregated contract
-            (advisor r03 medium). The fused failure itself is logged so a
-            persistent group-path regression stays visible even when every
-            member recovers."""
+            (advisor r03 medium). Callers format the traceback and exit
+            their ``except`` block BEFORE calling this: a live exception
+            would pin the failed group's device arrays via its traceback
+            frames exactly while the re-runs contend for that HBM. The
+            fused failure is still logged so a persistent group-path
+            regression stays visible even when every member recovers."""
             print(
                 f"Fused --video_batch {phase} failed for a group of "
                 f"{len(items)}; falling back to per-video dispatch:"
             )
-            traceback.print_exc()
+            print(fused_err, end="")
             for pos, e, p in items:
                 run_solo(pos, e, p)
 
         def fetch_one():
             slots, handle, grouped, payloads = inflight.popleft()
             if grouped:
+                fused_err = None
                 try:
                     with self.timer.stage("device"):
                         dicts = self.fetch_group(handle)
                 except KeyboardInterrupt:
                     raise
                 except Exception:  # noqa: BLE001 - fused fetch fails together
+                    fused_err = traceback.format_exc()
+                if fused_err is not None:
                     # free the dead group's device buffers before the solo
                     # re-runs, or they contend for the HBM that may have
-                    # caused the failure
+                    # caused the failure; the except block above has already
+                    # exited, so no live traceback pins them either
                     del handle
                     solo_fallback(
                         [(pos, e, p) for (pos, e), p in zip(slots, payloads)],
                         "fetch",
+                        fused_err,
                     )
                     return
                 for (pos, e), d in zip(slots, dicts):
@@ -318,13 +326,16 @@ class BaseExtractor:
         def dispatch_group_now(items):  # items: [(pos, entry, payload)]
             entries = [e for _, e, _ in items]
             payloads = [p for _, _, p in items]
+            fused_err = None
             try:
                 with self.timer.stage("device"):
                     handle = self.dispatch_group(device, state, entries, payloads)
             except KeyboardInterrupt:
                 raise
             except Exception:  # noqa: BLE001 - fused dispatch fails together
-                solo_fallback(items, "dispatch")
+                fused_err = traceback.format_exc()
+            if fused_err is not None:
+                solo_fallback(items, "dispatch", fused_err)
                 return
             inflight.append(
                 ([(pos, e) for pos, e, _ in items], handle, True, payloads)

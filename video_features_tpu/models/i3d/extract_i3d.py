@@ -119,9 +119,9 @@ class ExtractI3D(BaseExtractor):
         self.step_size = int(self.config.step_size or DEFAULT_STEP_SIZE)
         # --batch_size B: window stacks per fused device call (the
         # reference's i3d path ignores the flag; here it batches stacks
-        # the way its 2D nets batch frames). The last group repeats its
-        # final stack up to B so XLA keeps one compiled shape; surplus
-        # outputs are sliced off. Mesh runs pin B=1 — there the stack's
+        # the way its 2D nets batch frames). The last group is zero-padded
+        # up to B (ops/window.pad_batch) so XLA keeps one compiled shape;
+        # surplus outputs are sliced off. Mesh runs pin B=1 — there the stack's
         # FRAME axis is what shards (sequence parallelism).
         self.stack_batch = max(int(self.config.batch_size or 1), 1)
         self._host_params: Dict[str, object] = {}

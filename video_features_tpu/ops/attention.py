@@ -39,10 +39,14 @@ _MASK_VALUE = -1e30
 def _check_kv_len(kv_len) -> None:
     """Static-value guard: a concrete kv_len < 1 is a caller bug (the
     all-masked softmax is mean-of-padding, not zeros — see _finalize).
-    Traced values can't be checked without a device round-trip."""
-    if kv_len is not None and not isinstance(kv_len, jax.core.Tracer):
-        import numpy as _np
+    Only host values are checked (a positive isinstance guard — no
+    jax.core introspection, no device round-trip); traced/device values
+    are the caller's contract."""
+    if kv_len is None:
+        return
+    import numpy as _np
 
+    if isinstance(kv_len, (int, _np.integer, _np.ndarray)):
         val = _np.asarray(kv_len)
         if val.size and int(val.min()) < 1:
             raise ValueError(f"kv_len must be >= 1, got {val.min()}")
