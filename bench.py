@@ -380,39 +380,53 @@ def bench_i3d_device_only() -> dict:
     from video_features_tpu.models.raft.model import init_params as raft_init
     from video_features_tpu.ops.preprocess import flow_to_uint8, scale_to_1_1
 
+    from video_features_tpu.models.common.weights import cast_floats_for_compute
+
     run, forced = _device_only_gate()
     if not run:
         return {}
     S, H, W, K = (5, 256, 256, 1) if forced else (65, 256, 256, 4)
-    raft = raft_build()
-    i3d = i3d_build()
     p_raft = jax.device_put(raft_init())
-    p_rgb = jax.device_put(i3d_init("rgb"))
-    p_flow = jax.device_put(i3d_init("flow"))
+    host_rgb, host_flow = i3d_init("rgb"), i3d_init("flow")
     stack = jax.device_put(
         jnp.asarray(
             np.random.RandomState(0).randint(0, 255, (S, H, W, 3)).astype(np.float32)
         )
     )
 
-    def step(p_raft, p_rgb, p_flow, stack):
-        flow = raft.apply({"params": p_raft}, stack)  # (S-1, H, W, 2)
-        f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
-        flow_feats, _ = i3d.apply({"params": p_flow}, f[None])
-        rgb = scale_to_1_1(center_crop(stack[:-1]))
-        rgb_feats, _ = i3d.apply({"params": p_rgb}, rgb[None])
-        return flow_feats, rgb_feats
-
-    flops, best = _time_device_only(step, (p_raft, p_rgb, p_flow, stack), K)
-    sps = K / best
-    out = {"i3d_raft_device_only_sps": round(sps, 3)}
+    out = {}
     if forced:  # smoke-only label, as in bench_clip_device_only
         out["device_only_forced_smoke"] = True
-    if flops:
-        out["i3d_raft_flops_per_stack"] = round(flops / 1e9, 1)  # GFLOP
-        out["i3d_raft_mfu_fp32_of_bf16_peak"] = round(
-            sps * flops / V5E_BF16_PEAK_FLOPS, 4
-        )
+    # fp32 vs --dtype bfloat16 (RAFT mixed-precision graph + bf16 I3D,
+    # the r4 north-star uplift — VERDICT r03 next #2 asked for exactly
+    # this before/after on one scale)
+    for tag, dt in (("fp32", jnp.float32), ("bf16", jnp.bfloat16)):
+        raft = raft_build(dtype=dt)
+        i3d = i3d_build(dtype=dt)
+        if dt == jnp.float32:
+            p_rgb, p_flow = host_rgb, host_flow
+        else:
+            p_rgb = cast_floats_for_compute(host_rgb, dt, exclude=("conv3d_0c_1x1",))
+            p_flow = cast_floats_for_compute(host_flow, dt, exclude=("conv3d_0c_1x1",))
+        p_rgb, p_flow = jax.device_put(p_rgb), jax.device_put(p_flow)
+
+        def step(p_raft, p_rgb, p_flow, stack, raft=raft, i3d=i3d):
+            flow = raft.apply({"params": p_raft}, stack)  # (S-1, H, W, 2)
+            f = scale_to_1_1(flow_to_uint8(center_crop(flow)))
+            flow_feats, _ = i3d.apply({"params": p_flow}, f[None])
+            rgb = scale_to_1_1(center_crop(stack[:-1]))
+            rgb_feats, _ = i3d.apply({"params": p_rgb}, rgb[None])
+            return flow_feats, rgb_feats
+
+        flops, best = _time_device_only(step, (p_raft, p_rgb, p_flow, stack), K)
+        sps = K / best
+        sfx = "" if tag == "fp32" else "_bf16"  # fp32 keys keep r03 names
+        out[f"i3d_raft_device_only_sps{sfx}"] = round(sps, 3)
+        if flops:
+            out[f"i3d_raft_flops_per_stack{sfx}"] = round(flops / 1e9, 1)  # GFLOP
+            out[f"i3d_raft_mfu_{tag}_of_bf16_peak"] = round(
+                sps * flops / V5E_BF16_PEAK_FLOPS, 4
+            )
     return out
 
 

@@ -103,3 +103,31 @@ def test_dtype_flag_reaches_extractor(sample_video, tmp_path):
     bf16 = run("bfloat16")
     assert bf16.dtype == np.float32 and bf16.shape == f32.shape
     assert 0 < _rel(bf16, f32) < 0.03  # different numerics, same features
+
+
+def test_i3d_raft_bf16_flow_stream(sample_video, tmp_path):
+    """--dtype bfloat16 on the north-star config (i3d + raft flow): the
+    flow stream now runs RAFT's mixed-precision graph (r4) feeding a bf16
+    I3D through the fp32-pinned flow_to_uint8 quantizer. Features must
+    stay fp32 and land near the fp32 run — through BOTH bf16 nets AND the
+    one-level quantizer flips the raft drift budget allows."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def run(dtype):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            streams=["flow"],
+            flow_type="raft",
+            video_paths=[sample_video],
+            dtype=dtype,
+            cpu=True,
+        )
+        ex = ExtractI3D(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0])[0]["flow"]
+
+    f32 = run("float32")
+    bf16 = run("bfloat16")
+    assert bf16.dtype == np.float32 and bf16.shape == f32.shape
+    assert 0 < _rel(bf16, f32) < 0.05

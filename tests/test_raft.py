@@ -105,3 +105,72 @@ def test_lookup_corr_matches_gather_sampler():
         want.append(np.asarray(sampled).reshape(N, H, W, (2 * r + 1) ** 2))
     want = np.concatenate(want, axis=-1)
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_mixed_precision_flow_drift():
+    """--dtype bfloat16 RAFT (convs bf16, refinement recurrence pinned
+    fp32) vs the fp32 graph, full channel widths (VERDICT r03 next #2).
+
+    The quantization-budget claim — flow_to_uint8 buckets flow into
+    40/255 ~ 0.157 px levels, so drift under half a level (0.078 px)
+    cannot change I3D features — holds for a CONVERGENT refinement, which
+    is what trained RAFT is (deltas shrink toward a fixed point; flow
+    magnitudes are physical, |flow| clamped to 20 px by the quantizer
+    anyway). Fully random init is NOT that regime: the 20 untrained
+    iterations form a non-contracting map whose flow wanders to ~100 px
+    on a 128 px frame, and any rounding grows with it. So this pins BOTH:
+
+    1. contracting regime (delta head scaled 0.05 — the same full graph,
+       per-iteration updates small like a trained net's): absolute drift
+       must beat the half-level budget, and the actual uint8 quantizer
+       must agree to within one level;
+    2. chaotic full-random regime: relative L2 stays at bf16's ~0.5%
+       scale, i.e. drift only ever grows WITH the flow magnitude, never
+       independently of it.
+    """
+    import flax
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.raft.model import build, init_params
+    from video_features_tpu.ops.preprocess import flow_to_uint8
+
+    H = W = 128
+    rng = np.random.RandomState(0)
+    base = rng.uniform(0, 255, size=(H + 8, W + 8)).astype(np.float32)
+    # frame 2 is frame 1 shifted by (3, 2) px: genuine coherent motion
+    f1 = base[4 : 4 + H, 4 : 4 + W]
+    f2 = base[4 - 3 : 4 - 3 + H, 4 - 2 : 4 - 2 + W]
+    frames = jnp.asarray(
+        np.stack([np.stack([f1] * 3, -1), np.stack([f2] * 3, -1)])
+    )
+
+    params = init_params()
+    flat = flax.traverse_util.flatten_dict(params)
+    for k in list(flat):
+        if "flow_head" in "/".join(map(str, k)) and k[-2] == "conv2":
+            flat[k] = flat[k] * 0.05
+    params_contracting = flax.traverse_util.unflatten_dict(flat)
+
+    m32, m16 = build(dtype=jnp.float32), build(dtype=jnp.bfloat16)
+
+    # 1. contracting regime: the absolute half-level budget
+    f32 = np.asarray(m32.apply({"params": params_contracting}, frames))
+    f16 = np.asarray(m16.apply({"params": params_contracting}, frames))
+    assert np.abs(f32).max() < 20.0  # physical flow scale, inside the clamp
+    drift = np.abs(f32 - f16).max()
+    assert drift < 0.078, f"flow drift {drift:.4f} px exceeds half a uint8 level"
+    level_diff = np.abs(
+        np.asarray(flow_to_uint8(jnp.asarray(f32)), np.int16)
+        - np.asarray(flow_to_uint8(jnp.asarray(f16)), np.int16)
+    )
+    assert level_diff.max() <= 1
+    # sub-half-level drift still flips values sitting near bucket edges;
+    # what matters is that flips are rare and never exceed one level
+    assert (level_diff == 0).mean() > 0.9
+
+    # 2. chaotic regime: drift stays relative (~bf16 scale), nothing blows
+    # up independently of the flow magnitude
+    f32 = np.asarray(m32.apply({"params": params}, frames))
+    f16 = np.asarray(m16.apply({"params": params}, frames))
+    rel = np.linalg.norm(f32 - f16) / np.linalg.norm(f32)
+    assert rel < 0.02, f"relative L2 drift {rel:.4f} out of bf16 scale"

@@ -51,7 +51,13 @@ class ExtractRAFT(PairwiseFlowExtractor):
     _convert_state_dict = staticmethod(convert_state_dict)
 
     def _model(self):
-        return build()
+        # --dtype bfloat16 selects RAFT's mixed-precision graph: convs on
+        # the MXU in bf16, the refinement recurrence (corr volume, GRU
+        # carry, coords accumulator, upsampling) pinned fp32 — see
+        # models/raft/model.py docstring for the drift budget
+        from video_features_tpu.models.common.weights import compute_dtype
+
+        return build(dtype=compute_dtype(self.config))
 
     def _init_params(self):
         return init_params()

@@ -19,6 +19,16 @@ TPU-first redesign, numerically equivalent to the reference:
   (ref raft_src/raft.py:151-168 loops eagerly in Python).
 - Convex upsampling is a shifted-window einsum (the reference's
   unfold+softmax, ref raft_src/raft.py:102-111).
+- Mixed precision (``dtype=bfloat16``): every CONV — the encoders and the
+  20x motion-encoder/GRU/flow-head/mask stacks, which is where the FLOPs
+  are — computes in bf16 on the MXU, while everything the refinement
+  recurrence ACCUMULATES through stays fp32: the correlation volume and
+  its window lookup, the GRU gate math and hidden-state carry, the
+  coords1 flow accumulator, and the convex-upsampling softmax. Params
+  are always stored fp32. The budget: I3D's flow stream quantizes flow
+  through ``flow_to_uint8`` (clamp ±20 -> 255 levels ~ 0.157 px/level),
+  so conv-level drift far below half a level cannot change features
+  (tests/test_raft.py::test_mixed_precision_flow_drift pins this).
 
 Inputs are raw RGB floats in [0, 255]; scaling to [-1, 1] happens inside
 (ref raft_src/raft.py:118-119).
@@ -42,28 +52,33 @@ CONTEXT_DIM = 128
 
 class InstanceNorm(nn.Module):
     """torch InstanceNorm2d defaults: no affine params, eps=1e-5,
-    always normalizes with the sample's own (H, W) statistics."""
+    always normalizes with the sample's own (H, W) statistics. Stats are
+    fp32 even for a bf16 stream (a bf16 mean over H*W pixels loses ~2
+    digits); the result returns in the incoming dtype."""
 
     eps: float = 1e-5
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        mean = jnp.mean(x, axis=(1, 2), keepdims=True)
-        var = jnp.var(x, axis=(1, 2), keepdims=True)
-        return (x - mean) * jax.lax.rsqrt(var + self.eps)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+        var = jnp.var(x32, axis=(1, 2), keepdims=True)
+        return ((x32 - mean) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
 
 
 def _norm(kind: str, name: str):
     return EvalBatchNorm(name=name) if kind == "batch" else InstanceNorm(name=name)
 
 
-def _conv(features: int, kernel, stride: int = 1, name: str = None):
+def _conv(features: int, kernel, stride: int = 1, name: str = None,
+          dtype=jnp.float32):
     kh, kw = kernel if isinstance(kernel, tuple) else (kernel, kernel)
     return nn.Conv(
         features,
         (kh, kw),
         strides=(stride, stride),
         padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dtype=dtype,
         name=name,
     )
 
@@ -72,15 +87,18 @@ class ResidualBlock(nn.Module):
     planes: int
     norm: str
     stride: int = 1
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        y = nn.relu(_norm(self.norm, "norm1")(_conv(self.planes, 3, self.stride, "conv1")(x)))
-        y = nn.relu(_norm(self.norm, "norm2")(_conv(self.planes, 3, 1, "conv2")(y)))
+        dt = self.dtype
+        y = nn.relu(_norm(self.norm, "norm1")(_conv(self.planes, 3, self.stride, "conv1", dt)(x)))
+        y = nn.relu(_norm(self.norm, "norm2")(_conv(self.planes, 3, 1, "conv2", dt)(y)))
         if self.stride != 1:
-            x = nn.Conv(self.planes, (1, 1), strides=(self.stride,) * 2, name="downsample")(x)
+            x = nn.Conv(self.planes, (1, 1), strides=(self.stride,) * 2,
+                        dtype=dt, name="downsample")(x)
             x = _norm(self.norm, "norm3")(x)
-        return nn.relu(x + y)
+        return nn.relu(x.astype(dt) + y)
 
 
 class BasicEncoder(nn.Module):
@@ -88,15 +106,17 @@ class BasicEncoder(nn.Module):
 
     output_dim: int
     norm: str
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = _conv(64, 7, 2, "conv1")(x)
+        dt = self.dtype
+        x = _conv(64, 7, 2, "conv1", dt)(x)
         x = nn.relu(_norm(self.norm, "norm1")(x))
         for i, (dim, stride) in enumerate(((64, 1), (96, 2), (128, 2)), start=1):
-            x = ResidualBlock(dim, self.norm, stride, name=f"layer{i}_0")(x)
-            x = ResidualBlock(dim, self.norm, 1, name=f"layer{i}_1")(x)
-        return nn.Conv(self.output_dim, (1, 1), name="conv2")(x)
+            x = ResidualBlock(dim, self.norm, stride, dtype=dt, name=f"layer{i}_0")(x)
+            x = ResidualBlock(dim, self.norm, 1, dtype=dt, name=f"layer{i}_1")(x)
+        return nn.Conv(self.output_dim, (1, 1), dtype=dt, name="conv2")(x)
 
 
 # --- correlation pyramid ----------------------------------------------------
@@ -186,60 +206,84 @@ def lookup_corr(
 # --- update block -----------------------------------------------------------
 
 class BasicMotionEncoder(nn.Module):
-    """ref raft_src/update.py:85-103."""
+    """ref raft_src/update.py:85-103. Convs in ``dtype``; the fp32 corr
+    samples and flow enter through the convs' own input cast, and the
+    appended raw-flow channels are cast to match — conditioning inputs
+    only, the fp32 flow ACCUMULATOR lives in UpdateCell's carry."""
+
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, flow: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
-        cor = nn.relu(nn.Conv(256, (1, 1), name="convc1")(corr))
-        cor = nn.relu(_conv(192, 3, 1, "convc2")(cor))
-        flo = nn.relu(_conv(128, 7, 1, "convf1")(flow))
-        flo = nn.relu(_conv(64, 3, 1, "convf2")(flo))
-        out = nn.relu(_conv(128 - 2, 3, 1, "conv")(jnp.concatenate([cor, flo], -1)))
-        return jnp.concatenate([out, flow], -1)
+        dt = self.dtype
+        cor = nn.relu(nn.Conv(256, (1, 1), dtype=dt, name="convc1")(corr))
+        cor = nn.relu(_conv(192, 3, 1, "convc2", dt)(cor))
+        flo = nn.relu(_conv(128, 7, 1, "convf1", dt)(flow))
+        flo = nn.relu(_conv(64, 3, 1, "convf2", dt)(flo))
+        out = nn.relu(_conv(128 - 2, 3, 1, "conv", dt)(jnp.concatenate([cor, flo], -1)))
+        return jnp.concatenate([out, flow.astype(dt)], -1)
 
 
 class SepConvGRU(nn.Module):
-    """Separable 1x5 + 5x1 ConvGRU (ref raft_src/update.py:37-65)."""
+    """Separable 1x5 + 5x1 ConvGRU (ref raft_src/update.py:37-65).
+
+    Mixed precision: the six gate convs run in ``dtype``, but the gate
+    nonlinearities and the convex hidden-state update run fp32 on an fp32
+    carry — the recurrence is 20 steps deep and ``h`` is exactly what
+    bf16 rounding would compound through."""
 
     hidden: int = HIDDEN_DIM
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+        dt = self.dtype
+        x = x.astype(dt)
         for sfx, kernel in (("1", (1, 5)), ("2", (5, 1))):
-            hx = jnp.concatenate([h, x], -1)
-            z = nn.sigmoid(_conv(self.hidden, kernel, 1, f"convz{sfx}")(hx))
-            r = nn.sigmoid(_conv(self.hidden, kernel, 1, f"convr{sfx}")(hx))
+            hx = jnp.concatenate([h.astype(dt), x], -1)
+            z = nn.sigmoid(_conv(self.hidden, kernel, 1, f"convz{sfx}", dt)(hx).astype(jnp.float32))
+            r = nn.sigmoid(_conv(self.hidden, kernel, 1, f"convr{sfx}", dt)(hx).astype(jnp.float32))
             q = jnp.tanh(
-                _conv(self.hidden, kernel, 1, f"convq{sfx}")(
-                    jnp.concatenate([r * h, x], -1)
-                )
+                _conv(self.hidden, kernel, 1, f"convq{sfx}", dt)(
+                    jnp.concatenate([(r * h).astype(dt), x], -1)
+                ).astype(jnp.float32)
             )
             h = (1 - z) * h + z * q
         return h
 
 
 class FlowHead(nn.Module):
+    dtype: jnp.dtype = jnp.float32
+
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        return _conv(2, 3, 1, "conv2")(nn.relu(_conv(256, 3, 1, "conv1")(x)))
+        dt = self.dtype
+        return _conv(2, 3, 1, "conv2", dt)(nn.relu(_conv(256, 3, 1, "conv1", dt)(x)))
 
 
 class UpdateCell(nn.Module):
     """One refinement iteration: corr lookup -> motion encoder -> GRU ->
     flow delta + upsampling mask (ref raft_src/update.py:121-139,
-    raft.py:151-162). Written as a scan cell; ``consts`` are broadcast."""
+    raft.py:151-162). Written as a scan cell; ``consts`` are broadcast.
+    The carry (net, coords1, mask) is pinned fp32; ``dtype`` governs only
+    the conv compute inside the cell."""
+
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, carry, consts):
+        dt = self.dtype
         net, coords1, _ = carry
         pyramid, inp, coords0 = consts
         corr = lookup_corr(pyramid, coords1)
         flow = coords1 - coords0
-        motion = BasicMotionEncoder(name="encoder")(flow, corr)
-        net = SepConvGRU(name="gru")(net, jnp.concatenate([inp, motion], -1))
-        delta = FlowHead(name="flow_head")(net)
-        m = nn.relu(_conv(256, 3, 1, "mask_0")(net))
-        mask = 0.25 * nn.Conv(64 * 9, (1, 1), name="mask_2")(m)
+        motion = BasicMotionEncoder(dtype=dt, name="encoder")(flow, corr)
+        net = SepConvGRU(dtype=dt, name="gru")(
+            net, jnp.concatenate([inp.astype(dt), motion.astype(dt)], -1)
+        )
+        delta = FlowHead(dtype=dt, name="flow_head")(net).astype(jnp.float32)
+        m = nn.relu(_conv(256, 3, 1, "mask_0", dt)(net))
+        mask = 0.25 * nn.Conv(64 * 9, (1, 1), dtype=dt, name="mask_2")(m).astype(jnp.float32)
         return (net, coords1 + delta, mask), None
 
 
@@ -268,20 +312,30 @@ def upsample_flow(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 class RAFT(nn.Module):
     """(T, H, W, 3) RGB floats in [0,255], H and W divisible by 8 ->
-    (T-1, H, W, 2) flow for each consecutive frame pair."""
+    (T-1, H, W, 2) flow for each consecutive frame pair.
+
+    ``dtype=bfloat16`` selects the mixed-precision graph (module
+    docstring); the returned flow is always fp32."""
 
     iters: int = 20
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, frames: jnp.ndarray) -> jnp.ndarray:
         x = 2.0 * (frames / 255.0) - 1.0
 
-        fmap = BasicEncoder(256, "instance", name="fnet")(x)
-        pyramid = build_corr_pyramid(fmap[:-1], fmap[1:])
+        fmap = BasicEncoder(256, "instance", dtype=self.dtype, name="fnet")(x)
+        # the volume feeds 20 lookup iterations: build and sample it fp32
+        # even when the encoders computed in bf16
+        pyramid = build_corr_pyramid(
+            fmap[:-1].astype(jnp.float32), fmap[1:].astype(jnp.float32)
+        )
 
-        cnet = BasicEncoder(HIDDEN_DIM + CONTEXT_DIM, "batch", name="cnet")(x[:-1])
-        net, inp = jnp.split(cnet, 2, axis=-1)
-        net = jnp.tanh(net)
+        cnet = BasicEncoder(
+            HIDDEN_DIM + CONTEXT_DIM, "batch", dtype=self.dtype, name="cnet"
+        )(x[:-1])
+        net, inp = jnp.split(cnet.astype(jnp.float32), 2, axis=-1)
+        net = jnp.tanh(net)  # fp32: this is the GRU's fp32 initial carry
         inp = nn.relu(inp)
 
         N, H8, W8, _ = net.shape
@@ -295,14 +349,14 @@ class RAFT(nn.Module):
             in_axes=nn.broadcast,
             length=self.iters,
         )
-        (net, coords1, mask), _ = scan(name="update_block")(
+        (net, coords1, mask), _ = scan(dtype=self.dtype, name="update_block")(
             (net, coords0, mask0), (pyramid, inp, coords0)
         )
         return upsample_flow(coords1 - coords0, mask)
 
 
-def build(iters: int = 20) -> RAFT:
-    return RAFT(iters=iters)
+def build(iters: int = 20, dtype=jnp.float32) -> RAFT:
+    return RAFT(iters=iters, dtype=dtype)
 
 
 def init_params(seed: int = 0, iters: int = 20):
