@@ -452,3 +452,151 @@ def test_vggish_aggregated_matches_individual(three_wavs, tmp_path):
     for i, (s, f) in enumerate(zip(solo, fused)):
         assert f["vggish"].shape == (i + 1, 128)  # 1.5/2.5/3.5 s -> 1/2/3
         np.testing.assert_allclose(f["vggish"], s["vggish"], atol=2e-5, rtol=1e-5)
+
+
+# --- r4: flow (raft/pwc) and i3d stack aggregation -------------------------
+
+
+@pytest.fixture(scope="module")
+def three_flow_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    root = tmp_path_factory.mktemp("agg_flow_media")
+    # 9/13/17 frames at B=4 pairs -> 2/3/4 windows: fused chunks of 3
+    # windows cross video boundaries twice AND flush a partial chunk
+    return [
+        synth_video(
+            str(root / f"f{i}.mp4"), n_frames=9 + 4 * i,
+            width=96, height=64, seed=10 + i,
+        )
+        for i in range(3)
+    ]
+
+
+def _flow_cfg(feature_type, paths, tmp_path, **kw):
+    return ExtractionConfig(
+        allow_random_init=True,
+        feature_type=feature_type,
+        video_paths=list(paths),
+        batch_size=4,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("feature_type", ["raft", "pwc"])
+def test_flow_aggregated_matches_individual(
+    feature_type, three_flow_videos, tmp_path
+):
+    """--video_batch on the flow extractors: windows fused across videos
+    (vmapped forward) must reproduce the per-video dispatch path — the
+    reference only ever batches pairs WITHIN one video (ref
+    extract_raft.py:143-146)."""
+    from video_features_tpu.extract.registry import build_extractor
+
+    solo = build_extractor(
+        _flow_cfg(feature_type, three_flow_videos, tmp_path), external_call=True
+    )()
+    fused = build_extractor(
+        _flow_cfg(feature_type, three_flow_videos, tmp_path, video_batch=3),
+        external_call=True,
+    )()
+    assert len(solo) == len(fused) == 3
+    for i, (s, f) in enumerate(zip(solo, fused)):
+        n_frames = 9 + 4 * i
+        assert f[feature_type].shape[0] == n_frames - 1
+        assert f[feature_type].shape[1] == 2
+        np.testing.assert_allclose(
+            f[feature_type], s[feature_type], atol=1e-3, rtol=1e-3
+        )
+        np.testing.assert_array_equal(f["timestamps_ms"], s["timestamps_ms"])
+
+
+def test_flow_aggregation_isolates_bad_video(three_flow_videos, tmp_path, capsys):
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"not a video")
+    paths = [three_flow_videos[0], str(bad), three_flow_videos[1]]
+    fused = ExtractPWC(
+        _flow_cfg("pwc", paths, tmp_path, video_batch=3), external_call=True
+    )()
+    assert len(fused) == 2
+    assert "An error occurred" in capsys.readouterr().out
+
+
+def test_flow_agg_key_declines_stream_and_groups_by_shape(
+    three_flow_videos, tmp_path
+):
+    """Unit contract: show_pred and over-cap videos route solo
+    (agg_key=None); same-resolution payloads share a key, different
+    resolutions do not."""
+    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+    from video_features_tpu.utils.synth import synth_video
+
+    ex = ExtractRAFT(
+        _flow_cfg("raft", three_flow_videos, tmp_path), external_call=True
+    )
+    p0 = ex.prepare(three_flow_videos[0])
+    p1 = ex.prepare(three_flow_videos[1])
+    assert ex.agg_key(p0) == ex.agg_key(p1) is not None
+    other = synth_video(
+        str(tmp_path / "wide.mp4"), n_frames=9, width=160, height=64
+    )
+    assert ex.agg_key(ex.prepare(other)) != ex.agg_key(p0)
+    assert ex.agg_key(("stream", three_flow_videos[0])) is None
+    ex.AGG_MAX_BYTES = 1
+    assert ex.agg_key(p0) is None
+
+
+def test_i3d_stacks_aggregated_match_individual(four_videos, tmp_path):
+    """--video_batch on i3d: three 1-stack videos fill --batch_size stack
+    groups ACROSS videos (2+1-padded chunks) through the same compiled
+    executable; features must match the per-video dispatch."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def cfg(vb):
+        return ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            streams=["rgb"],
+            video_paths=list(four_videos[:3]),
+            batch_size=2,
+            video_batch=vb,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+
+    solo = ExtractI3D(cfg(1), external_call=True)()
+    fused = ExtractI3D(cfg(3), external_call=True)()
+    assert len(solo) == len(fused) == 3
+    for s, f in zip(solo, fused):
+        assert f["rgb"].shape == (1, 1024)
+        np.testing.assert_allclose(f["rgb"], s["rgb"], atol=2e-4, rtol=1e-4)
+        np.testing.assert_array_equal(f["timestamps_ms"], s["timestamps_ms"])
+
+
+def test_i3d_aggregation_isolates_bad_video(four_videos, tmp_path, capsys):
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"not a video")
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="i3d",
+        streams=["rgb"],
+        video_paths=[four_videos[0], str(bad), four_videos[1]],
+        batch_size=2,
+        video_batch=3,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    fused = ExtractI3D(cfg, external_call=True)()
+    assert len(fused) == 2
+    assert "An error occurred" in capsys.readouterr().out
+    for r in fused:
+        assert r["rgb"].shape == (1, 1024)

@@ -113,7 +113,22 @@ class PairwiseFlowExtractor(BaseExtractor):
         # short of the (data-divisible) frame axis, and explicit
         # out_shardings require divisibility — propagation handles it
         forward = jax.jit(forward)
-        return {"params": params, "forward": forward, "device": device}
+
+        # --video_batch fused path: G whole windows forward as one call,
+        # vmapped over the window axis (each window is an independent
+        # sequence — the pair views must NOT couple across videos). On a
+        # mesh the WINDOW axis shards over 'data' (pure DP, the same
+        # placement CLIP's fused batch uses) instead of the solo path's
+        # frame-axis sequence parallelism.
+        def forward_group(p, windows):  # (G, B+1, Hp, Wp, 3)
+            return jax.vmap(lambda w: model.apply({"params": p}, w))(windows)
+
+        return {
+            "params": params,
+            "forward": forward,
+            "forward_group": jax.jit(forward_group),
+            "device": device,
+        }
 
     def _preprocess(self, frame: np.ndarray) -> np.ndarray:
         if self.side_size is not None:
@@ -193,3 +208,166 @@ class PairwiseFlowExtractor(BaseExtractor):
             "fps": np.array(fps),
             "timestamps_ms": np.array(timestamps_ms),
         }
+
+    # --- async host pipeline (prepare/dispatch/fetch) ----------------------
+    # The reference's flow loop is strictly serial (decode a window, run
+    # it, repeat — ref extract_raft.py:93-146). Splitting it the same way
+    # as the 2D nets lets flow videos ride the 3-stage pipeline: decode on
+    # worker threads, all windows dispatched async, fetch overlapped.
+
+    PIPELINE_MAX_BYTES = 4 << 30
+
+    def _window_cap(self, frame: np.ndarray) -> int:
+        """Prefetch cap in FRAMES given one decoded (padded) frame."""
+        return self._prefetch_frame_cap(
+            self.PIPELINE_MAX_BYTES, frame.nbytes, floor=4 * self.batch_size
+        )
+
+    def prepare(self, path_entry):
+        # show_pred draws flow onto the raw frames per pair — keep the
+        # serial path where the frames are still in hand
+        if self.config.show_pred:
+            return ("stream", path_entry)
+        video_path = video_path_of(path_entry)
+        fps = (self.config.extraction_fps
+               or probe(video_path, self.config.decoder).fps or 25.0)
+
+        windows: List[np.ndarray] = []
+        n_pairs: List[int] = []
+        timestamps_ms: List[float] = []
+        batch: List[np.ndarray] = []
+        padder = None
+        cap = None
+        count = 0
+
+        def flush(batch):
+            # static (B+1)-frame shape: the tail window repeats its last
+            # frame (identical pairs compute zero-ish flow and are cut by
+            # the n_pairs slice), exactly like _dispatch_batch
+            n = len(batch) - 1
+            window = batch + [batch[-1]] * (self.batch_size + 1 - len(batch))
+            windows.append(padder.pad(np.stack(window)))
+            n_pairs.append(n)
+
+        for frame, ts in stream_frames(
+            video_path, self.config.extraction_fps, self.config.decoder
+        ):
+            count += 1
+            frame = self._preprocess(frame)
+            if padder is None:
+                padder = self._make_padder(frame.shape[:2])
+                cap = self._window_cap(padder.pad(frame[None])[0])
+            if count > cap:
+                return ("stream", path_entry)  # too big to prefetch whole
+            timestamps_ms.append(ts)
+            batch.append(frame)
+            if len(batch) - 1 == self.batch_size:
+                flush(batch)
+                batch = [batch[-1]]
+        if len(batch) > 1:
+            flush(batch)
+        if padder is None:
+            raise IOError(f"no frames decoded from {video_path}")
+        return windows, n_pairs, padder, fps, timestamps_ms
+
+    def _mesh_fill(self, state, w: np.ndarray) -> np.ndarray:
+        """Extend a (B+1)-frame window so the frame axis divides the mesh
+        'data' axis (last-frame repeat; surplus pairs fall to the n_pairs
+        slice) — the same rounding _dispatch_batch applies inline."""
+        from video_features_tpu.parallel.sharding import is_mesh
+
+        if not is_mesh(state["device"]):
+            return w
+        data = state["device"].shape["data"]
+        target = -(-w.shape[0] // data) * data
+        if target == w.shape[0]:
+            return w
+        reps = np.repeat(w[-1:], target - w.shape[0], axis=0)
+        return np.concatenate([w, reps], axis=0)
+
+    def dispatch_prepared(self, device, state, path_entry, payload):
+        if payload[0] == "stream":
+            return ("done", self.extract(device, state, payload[1]))
+        from video_features_tpu.parallel.sharding import place_batch
+
+        windows, n_pairs, padder, fps, timestamps_ms = payload
+        outs = []
+        for w, n in zip(windows, n_pairs):
+            x = place_batch(self._mesh_fill(state, w), state["device"])
+            outs.append((state["forward"](state["params"], x), n))
+        return ("batched", outs, padder, fps, timestamps_ms)
+
+    def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
+        if handle[0] == "done":
+            return handle[1]
+        _, outs, padder, fps, timestamps_ms = handle
+        flows: List[np.ndarray] = []
+        for out, n in outs:
+            flow = padder.unpad(np.asarray(out))[:n]
+            flows.extend(np.transpose(flow, (0, 3, 1, 2)))
+        return {
+            self.feature_type: np.array(flows),
+            "fps": np.array(fps),
+            "timestamps_ms": np.array(timestamps_ms),
+        }
+
+    # --- cross-video aggregation (--video_batch) ---------------------------
+    # A corpus of short clips yields windows with most pad-pairs wasted
+    # and one tiny dispatch per video on the deepest nets (VERDICT r03
+    # weak #4). Same-resolution windows are shape-identical, so G of them
+    # — from ANY mix of videos — fuse into one vmapped forward; outputs
+    # split back per video by window counts. The reference batches pairs
+    # only WITHIN a video (ref extract_raft.py:143-146).
+
+    AGG_MAX_BYTES = 512 << 20
+
+    def agg_key(self, payload):
+        if payload[0] == "stream":
+            return None
+        windows = payload[0]
+        if len(windows) * windows[0].nbytes > self.AGG_MAX_BYTES:
+            return None
+        return windows[0].shape  # (B+1, Hp, Wp, 3)
+
+    def dispatch_group(self, device, state, entries, payloads):
+        from video_features_tpu.ops.window import pad_batch
+        from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
+
+        group = max(int(self.config.video_batch or 1), 1)
+        flat_w = [w for p in payloads for w in p[0]]
+        flat_n = [n for p in payloads for n in p[1]]
+        outs = []
+        for i in range(0, len(flat_w), group):
+            chunk = flat_w[i : i + group]
+            g = len(chunk)
+            x = pad_batch(np.stack(chunk), group)  # one executable per key
+            x = pad_batch_for(state["device"], x)
+            x = place_batch(x, state["device"])
+            outs.append((state["forward_group"](state["params"], x), g))
+        metas = [(len(p[0]), p[2], p[3], p[4]) for p in payloads]
+        return outs, flat_n, metas
+
+    def fetch_group(self, handle):
+        outs, flat_n, metas = handle
+        per_window: List[np.ndarray] = []
+        i = 0
+        for out, g in outs:
+            arr = np.asarray(out)[:g]
+            for w in arr:
+                per_window.append(w[: flat_n[i]])
+                i += 1
+        dicts, off = [], 0
+        for count, padder, fps, timestamps_ms in metas:
+            flows: List[np.ndarray] = []
+            for w in per_window[off : off + count]:
+                flow = padder.unpad(w)
+                flows.extend(np.transpose(flow, (0, 3, 1, 2)))
+            off += count
+            dicts.append(
+                {
+                    self.feature_type: np.array(flows),
+                    "fps": np.array(fps),
+                    "timestamps_ms": np.array(timestamps_ms),
+                }
+            )
+        return dicts

@@ -602,6 +602,97 @@ class ExtractI3D(BaseExtractor):
                 for j in range(n_valid):
                     preds.append((base_idx + j, stream, arr[j]))
 
+    # --- cross-video aggregation (--video_batch) ---------------------------
+    # A corpus of short clips (one 65-frame stack each) dispatches one
+    # stack per video on the deepest pipeline in the framework — RAFT x 64
+    # pairs + two I3D towers (VERDICT r03 weak #4). Same-resolution stacks
+    # are shape-identical, so cross-video stacks FILL the --batch_size
+    # stack groups (the same compiled executable as within-video
+    # batching) instead of zero-padding them. Mesh runs keep the solo path
+    # (there the stack's frame axis shards — sequence parallelism).
+
+    AGG_MAX_FRAMES = 256
+
+    def agg_key(self, payload):
+        decoded, _, from_disk, _ = payload
+        if (
+            decoded is None  # over the prefetch cap: one resident at a time
+            or from_disk  # zipped frame+flow-image payloads don't fuse
+            or self.config.show_pred  # per-video print interleaving
+            or self.config.sharding == "mesh"
+        ):
+            return None
+        frames = decoded[0]
+        if len(frames) > self.AGG_MAX_FRAMES:
+            return None
+        return (
+            frames[0].shape[:2],
+            self.stack_size,
+            self.step_size,
+            tuple(self.streams),
+            self.flow_type,
+        )
+
+    def dispatch_group(self, device, state, entries, payloads):
+        from video_features_tpu.ops.window import pad_batch
+        from video_features_tpu.parallel.sharding import place_batch
+
+        group = self.stack_batch
+        window = self.stack_size + 1
+        stacks: List[np.ndarray] = []
+        counts: List[int] = []
+        metas = []
+        for decoded, _, _, _ in payloads:
+            frames, fps, timestamps_ms = decoded
+            slices = form_slices(len(frames), window, self.step_size)
+            stacks.extend(np.stack(frames[s:e]) for s, e in slices)
+            counts.append(len(slices))
+            metas.append((fps, timestamps_ms))
+        fns = self._fns_for_shape(state, stacks[0].shape[1:3])
+        outs = []
+        for i in range(0, len(stacks), group):
+            chunk = stacks[i : i + group]
+            n_valid = len(chunk)
+            x = place_batch(pad_batch(np.stack(chunk), group), state["device"])
+            souts = []
+            for stream in self.streams:
+                if stream == "rgb":
+                    f, _ = fns["rgb"](state["params"]["rgb"], x)
+                else:
+                    f, _ = fns["flow"](
+                        state["params"][self.flow_type],
+                        state["params"]["flow"],
+                        x,
+                    )
+                souts.append((stream, f))
+            outs.append((n_valid, souts))
+        return outs, counts, metas
+
+    def fetch_group(self, handle):
+        outs, counts, metas = handle
+        per_stream: Dict[str, List[np.ndarray]] = {s: [] for s in self.streams}
+        for n_valid, souts in outs:
+            for stream, f in souts:
+                per_stream[stream].append(np.asarray(f)[:n_valid])
+        cat = {
+            s: (
+                np.concatenate(v, axis=0).astype(np.float32)
+                if v
+                else np.zeros((0, 1024), np.float32)
+            )
+            for s, v in per_stream.items()
+        }
+        dicts, off = [], 0
+        for count, (fps, timestamps_ms) in zip(counts, metas):
+            d: Dict[str, np.ndarray] = {
+                s: cat[s][off : off + count] for s in self.streams
+            }
+            d["fps"] = np.array(fps)
+            d["timestamps_ms"] = np.array(timestamps_ms)
+            dicts.append(d)
+            off += count
+        return dicts
+
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
         feats, preds, pending, video_path, fps, timestamps_ms = handle
         if pending is not None:
