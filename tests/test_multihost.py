@@ -120,3 +120,116 @@ def test_two_process_cluster_runs_cross_host_collectives(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"proc {i} ok" in out
+
+
+_EXTRACT_WORKER = r"""
+import os, sys
+port, proc_id, video, out_dir, tmp_dir = sys.argv[1:6]
+
+import numpy as np
+import jax
+
+# re-pin cpu before the axon plugin's discovery can dial the chip tunnel
+# (same dance as the collectives worker above)
+jax.config.update("jax_platforms", "cpu")
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=int(proc_id),
+)
+assert len(jax.devices()) == 8, "global device view must span both processes"
+
+from video_features_tpu.cli import main as cli_main
+
+# the full product path: argv -> config -> registry -> mesh scheduler.
+# Every process runs the SAME path list in lockstep (each sharded
+# dispatch is collective); the sink gate writes on process 0 only.
+cli_main([
+    "--feature_type", "CLIP-ViT-B/32",
+    "--cpu", "--allow_random_init",
+    "--extract_method", "uni_4",
+    "--sharding", "mesh",
+    "--video_paths", video,
+    "--on_extraction", "save_numpy",
+    "--output_path", out_dir,
+    "--tmp_path", tmp_dir,
+])
+print(f"proc {proc_id} extraction ok")
+"""
+
+
+def test_two_process_cluster_runs_extraction_job(tmp_path):
+    """A real multi-host EXTRACTION job, not just collectives (VERDICT r03
+    next #4): both processes drive main.py's mesh path end-to-end on a
+    tiny CLIP config. Features must be byte-identical to a single-process
+    mesh run, and the sink must write exactly once (process 0)."""
+    import numpy as np
+
+    from video_features_tpu.utils.synth import synth_video
+
+    video = synth_video(str(tmp_path / "mh.mp4"), n_frames=12)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_COORDINATOR_ADDRESS"}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["USE_TF"] = "0"
+    env["PYTHONPATH"] = (
+        _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    script = tmp_path / "extract_worker.py"
+    script.write_text(_EXTRACT_WORKER)
+    out_dirs = [str(tmp_path / f"out{i}") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(i), video,
+             out_dirs[i], str(tmp_path / f"tmp{i}")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"proc {i} extraction ok" in out
+
+    # exactly-once sink: process 0 wrote the file set, process 1 nothing
+    wrote0 = sorted(pathlib.Path(out_dirs[0]).rglob("*.npy"))
+    assert len(wrote0) == 1, wrote0
+    assert not list(pathlib.Path(out_dirs[1]).rglob("*.npy"))
+
+    # byte-identical to a single-process 8-device mesh run of the same
+    # argv (this pytest process already owns 8 virtual devices)
+    ref_env = dict(env)
+    ref_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    ref_out = str(tmp_path / "ref_out")
+    ref_script = tmp_path / "ref_worker.py"
+    ref_script.write_text(
+        _EXTRACT_WORKER.replace(
+            "jax.distributed.initialize(\n"
+            "    coordinator_address=f\"127.0.0.1:{port}\", num_processes=2,\n"
+            "    process_id=int(proc_id),\n"
+            ")\n",
+            "",
+        )
+    )
+    r = subprocess.run(
+        [sys.executable, str(ref_script), "0", "0", video, ref_out,
+         str(tmp_path / "ref_tmp")],
+        env=ref_env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    ref_files = sorted(pathlib.Path(ref_out).rglob("*.npy"))
+    assert len(ref_files) == 1
+    got, want = np.load(wrote0[0]), np.load(ref_files[0])
+    np.testing.assert_array_equal(got, want)

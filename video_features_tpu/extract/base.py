@@ -144,6 +144,16 @@ class BaseExtractor:
         if self.external_call:
             results.append((order, feats_dict))
         else:
+            # multi-host mesh runs: every process executes the same loop
+            # on the same path list (the sharded dispatches are collective
+            # — all hosts must participate), but exactly ONE writes the
+            # output files. Features are replicated at graph exit
+            # (parallel/sharding.py::multihost), so process 0 holds the
+            # full arrays. Single-process runs: process_index() == 0.
+            import jax as _jax
+
+            if _jax.process_index() != 0:
+                return
             with self.timer.stage("sink"):
                 action_on_extraction(
                     feats_dict,

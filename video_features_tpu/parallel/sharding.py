@@ -85,6 +85,12 @@ def shard_params(params, mesh: Mesh, specs=None):
     return jax.device_put(params, shardings)
 
 
+def multihost() -> bool:
+    """True under a ``jax.distributed`` multi-controller runtime (a TPU
+    pod slice, or the simulated 2-process cluster in test_multihost)."""
+    return jax.process_count() > 1
+
+
 def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data"),
                         out_spec=P("data")):
     """jit ``model.apply`` with the batch sharded over 'data'.
@@ -93,9 +99,14 @@ def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data"),
     ``shard_params`` (their shardings flow into the jit as arguments).
     ``--mesh_context`` mode passes ``P()`` for both: the batch replicates
     and the token axis shards *inside* the model via ring attention.
+
+    Multi-host: outputs come back REPLICATED (an all-gather at graph
+    exit) instead of batch-sharded — ``np.asarray`` on a 'data'-sharded
+    global array raises "not fully addressable" on every host, and
+    features are tiny next to activations, so the gather is noise.
     """
     x_sharding = NamedSharding(mesh, batch_spec)
-    out_sharding = NamedSharding(mesh, out_spec)
+    out_sharding = NamedSharding(mesh, P() if multihost() else out_spec)
 
     @partial(jax.jit, out_shardings=out_sharding)
     def fn(p, x):
@@ -151,10 +162,11 @@ def pad_batch_for(device, batch: np.ndarray) -> np.ndarray:
 def jit_sharded_forward(fn, device, n_out: int = 1):
     """jit ``fn(params, x)`` for either execution mode: plain jit on a
     single device; on a Mesh, pin each output to P('data') so results come
-    back batch-sharded (params/input shardings flow in as arguments)."""
+    back batch-sharded (params/input shardings flow in as arguments).
+    Multi-host pins outputs replicated instead — see build_sharded_apply."""
     if not is_mesh(device):
         return jax.jit(fn)
-    out = NamedSharding(device, P("data"))
+    out = NamedSharding(device, P() if multihost() else P("data"))
     return jax.jit(fn, out_shardings=out if n_out == 1 else (out,) * n_out)
 
 
