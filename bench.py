@@ -250,6 +250,93 @@ def bench_flash_attention() -> dict:
     }
 
 
+def bench_host_pipeline() -> dict:
+    """Host-side decode/preprocess frames/s — the NON-chip half of the
+    end-to-end gap (VERDICT r03 next #7). Reported next to the
+    device-only numbers so `end-to-end vs device-only` deltas attribute
+    to host vs tunnel vs chip. Pure host CPU: runs identically whether
+    the relay is alive or not."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from PIL import Image
+
+    from video_features_tpu.io.video import stream_frames
+    from video_features_tpu.ops.preprocess import (
+        normalize_chw,
+        pil_center_crop,
+        pil_resize,
+        to_float_chw,
+    )
+    from video_features_tpu.utils.synth import synth_video
+
+    out = {}
+    tmp_ctx = tempfile.TemporaryDirectory()
+    with tmp_ctx as tmp:
+        video = synth_video(os.path.join(tmp, "host.mp4"), **CLIP_SPEC)
+
+        def decode_all(backend):
+            n = 0
+            for _f, _ts in stream_frames(video, None, backend):
+                n += 1
+            return n
+
+        for backend in ("cv2", "native"):
+            try:
+                decode_all(backend)  # warm: page cache + lazy lib build
+                t0 = time.perf_counter()
+                n = decode_all(backend)
+                out[f"host_decode_{backend}_fps"] = round(
+                    n / (time.perf_counter() - t0), 1
+                )
+            except Exception as e:  # noqa: BLE001 - native lib may not build
+                out[f"host_decode_{backend}_error"] = repr(e)
+
+        # --decode_workers scaling: W threads decoding 4 streams — the
+        # actual shape of the async pipeline's host stage (parallelism is
+        # across videos, not within one)
+        for w in (1, 2, 4):
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(w) as pool:
+                ns = list(pool.map(lambda _i: decode_all("cv2"), range(4)))
+            out[f"host_decode_workers_{w}_fps"] = round(
+                sum(ns) / (time.perf_counter() - t0), 1
+            )
+
+    # CLIP 224 preprocess: the pip-clip-exact PIL chain vs the C++ batch
+    rng = np.random.RandomState(0)
+    frames = rng.randint(
+        0, 255, (32, CLIP_SPEC["height"], CLIP_SPEC["width"], 3), dtype=np.uint8
+    )
+    mean = (0.48145466, 0.4578275, 0.40821073)
+    std = (0.26862954, 0.26130258, 0.27577711)
+
+    def pil_chain():
+        for f in frames:
+            img = pil_center_crop(
+                pil_resize(f, 224, interpolation=Image.BICUBIC), 224
+            )
+            normalize_chw(to_float_chw(img), mean, std)
+
+    pil_chain()  # warm
+    t0 = time.perf_counter()
+    pil_chain()
+    out["host_preprocess_pil_fps"] = round(
+        len(frames) / (time.perf_counter() - t0), 1
+    )
+    try:
+        from video_features_tpu import native
+
+        native.clip_preprocess_batch(frames, size=224)  # warm + build
+        t0 = time.perf_counter()
+        native.clip_preprocess_batch(frames, size=224)
+        out["host_preprocess_native_fps"] = round(
+            len(frames) / (time.perf_counter() - t0), 1
+        )
+    except Exception as e:  # noqa: BLE001 - native lib may not build
+        out["host_preprocess_native_error"] = repr(e)
+    return {"host_pipeline": out}
+
+
 # v5e peak: 197 TFLOP/s bf16 per chip (the MXU's native dtype; fp32
 # matmuls pass through the MXU slower — both MFU figures below are
 # reported against THIS number so they compare on one scale).
@@ -571,6 +658,7 @@ def main() -> None:
     # costs the fewest parts. Probe overhead per sub is ~seconds; compiles
     # hit the persistent XLA cache.
     sub_timeout = float(os.environ.get("BENCH_SUB_TIMEOUT", "1200"))
+    extra.update(bench_host_pipeline())  # pure host CPU, no device risk
     extra.update(_spawn_sub("clip_device_only", sub_timeout))
     extra.update(_spawn_sub("pallas_corr", sub_timeout))
     if os.environ.get("BENCH_SKIP_I3D") != "1":

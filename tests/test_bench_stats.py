@@ -89,3 +89,15 @@ def test_spawn_sub_runs_real_part_on_cpu():
     from bench import _spawn_sub
 
     assert _spawn_sub("pallas_corr", 300) == {}
+
+
+def test_host_pipeline_bench_runs_on_cpu():
+    """bench_host_pipeline is pure host CPU (no device risk) and must
+    always produce decode + preprocess figures so the end-to-end vs
+    device-only delta stays attributable even in relay-outage rounds."""
+    from bench import bench_host_pipeline
+
+    out = bench_host_pipeline()["host_pipeline"]
+    assert out["host_decode_cv2_fps"] > 0
+    assert out["host_preprocess_pil_fps"] > 0
+    assert any(k.startswith("host_decode_workers_") for k in out)
