@@ -19,10 +19,17 @@ timeout 120 python -c "import jax; print('devices:', jax.devices())" || {
   echo "jax.devices() hung/failed despite the listener; abort"; exit 1; }
 
 echo "== 2/3 bench (both north-star configs) =="
+# the final line is the JSON artifact; persist it INTO THE REPO so a
+# successful capture survives any later helper crash (r04: the first
+# window's CLIP numbers died with the process on the I3D compile —
+# bench.py is now subprocess-isolated per part, but the copy costs
+# nothing and makes the evidence durable either way)
 python bench.py | tee /tmp/bench_r04_local.json || {
   echo "bench FAILED (rc=$?) — no numbers captured; NOT proceeding to the"
   echo "helper-crash-risk flash compile. Re-run when the relay is stable."
   exit 1; }
+tail -n 1 /tmp/bench_r04_local.json > BENCH_r04_local.json
+echo "bench JSON persisted to BENCH_r04_local.json (commit it)"
 
 echo "== 3/3 one-off on-chip validations (riskiest compile last) =="
 python scripts/validate_flash_tpu.py \
