@@ -241,24 +241,44 @@ def test_clip_aggregation_on_mesh_matches_queue(four_videos, tmp_path):
         )
 
 
-def test_base_extractor_declines_aggregation_by_default(four_videos, tmp_path):
-    """Extractors without dispatch_group ignore --video_batch (no crash)."""
+def test_every_feature_type_supports_aggregation(four_videos, tmp_path):
+    """r4 closed the last --video_batch gaps (flow windows, i3d stacks):
+    EVERY registry extractor now implements dispatch_group. An extractor
+    can still decline per-payload via agg_key=None — i3d on a mesh pins
+    the solo path, where the frame axis is what shards."""
+    from video_features_tpu.config import FEATURE_TYPES
+    from video_features_tpu.extract.registry import build_extractor
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
 
-    ex = ExtractI3D(
+    for ft in FEATURE_TYPES:
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type=ft,
+            video_paths=list(four_videos[:1]),
+            video_batch=4,
+            extract_method="uni_4",
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+        assert build_extractor(cfg, external_call=True)._aggregation_enabled(), ft
+
+    mesh_i3d = ExtractI3D(
         ExtractionConfig(
             allow_random_init=True,
             feature_type="i3d",
             flow_type="raft",
             video_paths=list(four_videos[:1]),
             video_batch=4,
+            sharding="mesh",
             tmp_path=str(tmp_path / "tmp"),
             output_path=str(tmp_path / "out"),
             cpu=True,
         ),
         external_call=True,
     )
-    assert not ex._aggregation_enabled()
+    fake_payload = ((["frame"], 25.0, []), None, False, None)
+    assert mesh_i3d.agg_key(fake_payload) is None
 
 
 def test_aggregation_through_queue_scheduler(four_videos, tmp_path):

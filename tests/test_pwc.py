@@ -49,3 +49,58 @@ def test_extract_pwc_end_to_end(sample_video, tmp_path):
     # 12 frames -> 11 pairs, flow at source resolution
     assert flow.shape[0] == 11 and flow.shape[1] == 2
     assert np.isfinite(flow).all()
+
+
+def test_mixed_precision_flow_drift():
+    """--dtype bfloat16 PWC (conv stacks bf16; flow estimates, upflow,
+    warp grid, correlation volumes pinned fp32) vs the fp32 graph at full
+    channel widths — the same two-regime pin as RAFT's
+    (tests/test_raft.py): absolute half-quantizer-level budget in a
+    convergent-scale regime, relative-only drift in the raw random-init
+    regime (PWC is feedforward, but random decoders still emit large
+    unphysical flows that scale any rounding with them)."""
+    import flax
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.pwc.model import build, init_params
+    from video_features_tpu.ops.preprocess import flow_to_uint8
+
+    H = W = 128
+    rng = np.random.RandomState(0)
+    base = rng.uniform(0, 255, size=(H + 8, W + 8)).astype(np.float32)
+    f1 = base[4 : 4 + H, 4 : 4 + W]
+    f2 = base[1 : 1 + H, 2 : 2 + W]  # coherent (3, 2) px shift
+    frames = jnp.asarray(
+        np.stack([np.stack([f1] * 3, -1), np.stack([f2] * 3, -1)])
+    )
+
+    params = init_params()
+    flat = flax.traverse_util.flatten_dict(params)
+    for k in list(flat):
+        path = "/".join(map(str, k))
+        # scale every flow-emitting conv: decoder 'flow' heads + refiner
+        # conv6 — physical-magnitude proxy, same graph
+        if ("flow" in path and k[-2] == "flow") or (
+            "refiner" in path and k[-2] == "conv6"
+        ):
+            flat[k] = flat[k] * 0.05
+    params_small = flax.traverse_util.unflatten_dict(flat)
+
+    m32, m16 = build(dtype=jnp.float32), build(dtype=jnp.bfloat16)
+
+    f32out = np.asarray(m32.apply({"params": params_small}, frames))
+    f16out = np.asarray(m16.apply({"params": params_small}, frames))
+    assert np.abs(f32out).max() < 20.0
+    drift = np.abs(f32out - f16out).max()
+    assert drift < 0.078, f"flow drift {drift:.4f} px exceeds half a uint8 level"
+    level_diff = np.abs(
+        np.asarray(flow_to_uint8(jnp.asarray(f32out)), np.int16)
+        - np.asarray(flow_to_uint8(jnp.asarray(f16out)), np.int16)
+    )
+    assert level_diff.max() <= 1
+    assert (level_diff == 0).mean() > 0.9
+
+    f32out = np.asarray(m32.apply({"params": params}, frames))
+    f16out = np.asarray(m16.apply({"params": params}, frames))
+    rel = np.linalg.norm(f32out - f16out) / np.linalg.norm(f32out)
+    assert rel < 0.02, f"relative L2 drift {rel:.4f} out of bf16 scale"

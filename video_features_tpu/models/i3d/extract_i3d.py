@@ -198,10 +198,10 @@ class ExtractI3D(BaseExtractor):
         for stream in self.streams:
             p = self._params(stream)
             if dt != jnp.float32:
-                # I3D streams run bf16 (logits head stays fp32). RAFT runs
-                # its MIXED-precision graph (convs bf16, refinement
-                # recurrence pinned fp32 — models/raft/model.py docstring);
-                # PWC stays fp32 (its refinement has no fp32-pinned split)
+                # I3D streams run bf16 (logits head stays fp32). RAFT and
+                # PWC run their MIXED-precision graphs (convs bf16; flow
+                # estimates / corr / warp-or-lookup recurrence pinned fp32
+                # — models/{raft,pwc}/model.py docstrings)
                 p = cast_floats_for_compute(p, dt, exclude=("conv3d_0c_1x1",))
             state["params"][stream] = place_params(p, device)
         if "flow" in self.streams and self.flow_type in ("raft", "pwc"):
@@ -269,7 +269,7 @@ class ExtractI3D(BaseExtractor):
             elif "flow" in self.streams and self.flow_type == "pwc":
                 from video_features_tpu.models.pwc.model import build as pwc_build
 
-                pwc = pwc_build()
+                pwc = pwc_build(dtype=state.get("dtype", jnp.float32))
 
                 @jax.jit
                 def flow_fn(p_flow, p_i3d, stack):
@@ -324,7 +324,7 @@ class ExtractI3D(BaseExtractor):
         elif "flow" in self.streams and self.flow_type == "pwc":
             from video_features_tpu.models.pwc.model import build as pwc_build
 
-            pwc = pwc_build()
+            pwc = pwc_build(dtype=state.get("dtype", jnp.float32))
 
             @jax.jit
             def flow_fn(p_flow, p_i3d, stacks):  # (B, S+1, H, W, 3)

@@ -16,7 +16,12 @@ class ExtractPWC(PairwiseFlowExtractor):
     _convert_state_dict = staticmethod(convert_state_dict)
 
     def _model(self):
-        return build()
+        # --dtype bfloat16 selects PWC's mixed-precision graph: conv
+        # stacks bf16 on the MXU, every flow estimate / warp grid /
+        # correlation volume pinned fp32 — models/pwc/model.py docstring
+        from video_features_tpu.models.common.weights import compute_dtype
+
+        return build(dtype=compute_dtype(self.config))
 
     def _init_params(self):
         return init_params()

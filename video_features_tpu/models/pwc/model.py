@@ -21,6 +21,14 @@ TPU-first redesign, numerically equivalent:
 Inputs are raw RGB floats in [0, 255] at any resolution; the /64 resize
 and the ``20 * flow`` rescale back to input resolution happen inside
 (ref pwc_net.py:241-261).
+
+Mixed precision (``dtype=bfloat16``, r4 — same split as RAFT's): the
+extractor pyramid and the DenseNet decoder/refiner conv stacks (the
+FLOPs) compute in bf16 on the MXU, while everything the coarse-to-fine
+cascade STEERS by stays fp32: every flow estimate and the ``upflow``
+deconv that upsamples it, the backward-warp sampling grid and its
+partial mask, the correlation volumes, and the final resize/rescale.
+Params always stored fp32; returned flow always fp32.
 """
 
 from __future__ import annotations
@@ -49,7 +57,8 @@ def _lrelu(x):
     return nn.leaky_relu(x, negative_slope=0.1)
 
 
-def _conv(features: int, stride: int = 1, dilation: int = 1, name: str = None):
+def _conv(features: int, stride: int = 1, dilation: int = 1, name: str = None,
+          dtype=jnp.float32):
     p = dilation
     return nn.Conv(
         features,
@@ -57,6 +66,7 @@ def _conv(features: int, stride: int = 1, dilation: int = 1, name: str = None):
         strides=(stride, stride),
         padding=[(p, p), (p, p)],
         kernel_dilation=(dilation, dilation),
+        dtype=dtype,
         name=name,
     )
 
@@ -70,6 +80,7 @@ class TorchConvTranspose(nn.Module):
     """
 
     features: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -80,14 +91,14 @@ class TorchConvTranspose(nn.Module):
         )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
         y = jax.lax.conv_general_dilated(
-            x,
-            kernel,
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
             window_strides=(1, 1),
             padding=[(2, 2), (2, 2)],  # k - 1 - p
             lhs_dilation=(2, 2),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        return y + bias
+        return y + bias.astype(self.dtype)
 
 
 def backward_warp(feat: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
@@ -113,28 +124,44 @@ def backward_warp(feat: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
 
 class Decoder(nn.Module):
     """One pyramid level: correlation (+warp below level 6) -> dense conv
-    stack -> 2-channel flow (ref pwc_net.py:112-187)."""
+    stack -> 2-channel flow (ref pwc_net.py:112-187).
+
+    Mixed precision: the dense conv stack runs in ``dtype``; the flow
+    estimate, the ``upflow`` deconv that upsamples it, the warp (sampling
+    coordinates + partial mask), and the correlation volume are pinned
+    fp32 — they steer the next level's sampling positions."""
 
     level: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, feat1, feat2, prev: Tuple[jnp.ndarray, jnp.ndarray] = None):
+        f32 = jnp.float32
         if prev is None:
-            feat = _lrelu(local_correlation_nhwc(feat1, feat2))
+            feat = _lrelu(local_correlation_nhwc(feat1.astype(f32), feat2.astype(f32)))
         else:
-            flow_up = TorchConvTranspose(2, name="upflow")(prev[0])
-            feat_up = TorchConvTranspose(2, name="upfeat")(prev[1])
-            warped = backward_warp(feat2, flow_up * BACKWARD_SCALE[self.level])
-            volume = _lrelu(local_correlation_nhwc(feat1, warped))
-            feat = jnp.concatenate([volume, feat1, flow_up, feat_up], axis=-1)
+            flow_up = TorchConvTranspose(2, dtype=f32, name="upflow")(
+                prev[0].astype(f32)
+            )
+            feat_up = TorchConvTranspose(2, dtype=self.dtype, name="upfeat")(prev[1])
+            warped = backward_warp(
+                feat2.astype(f32), flow_up * BACKWARD_SCALE[self.level]
+            )
+            volume = _lrelu(local_correlation_nhwc(feat1.astype(f32), warped))
+            feat = jnp.concatenate(
+                [volume, feat1.astype(f32), flow_up, feat_up.astype(f32)], axis=-1
+            )
 
         assert feat.shape[-1] == DECODER_IN[self.level], (
             f"decoder level {self.level}: input width {feat.shape[-1]} != "
             f"{DECODER_IN[self.level]}"
         )
+        feat = feat.astype(self.dtype)  # one cast into the dense stack
         for i, ch in enumerate((128, 128, 96, 64, 32)):
-            feat = jnp.concatenate([_lrelu(_conv(ch, name=f"conv{i}")(feat)), feat], -1)
-        flow = _conv(2, name="flow")(feat)
+            feat = jnp.concatenate(
+                [_lrelu(_conv(ch, name=f"conv{i}", dtype=self.dtype)(feat)), feat], -1
+            )
+        flow = _conv(2, name="flow", dtype=self.dtype)(feat).astype(f32)
         return flow, feat
 
 
@@ -149,32 +176,44 @@ def local_correlation_nhwc(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
 class Extractor(nn.Module):
     """6-level strided conv pyramid (ref pwc_net.py:44-109)."""
 
+    dtype: jnp.dtype = jnp.float32
+
     @nn.compact
     def __call__(self, x: jnp.ndarray):
         feats = []
         for lvl, dim in enumerate(LEVEL_DIMS, start=1):
-            x = _lrelu(_conv(dim, 2, name=f"lvl{lvl}_conv0")(x))
-            x = _lrelu(_conv(dim, 1, name=f"lvl{lvl}_conv1")(x))
-            x = _lrelu(_conv(dim, 1, name=f"lvl{lvl}_conv2")(x))
+            x = _lrelu(_conv(dim, 2, name=f"lvl{lvl}_conv0", dtype=self.dtype)(x))
+            x = _lrelu(_conv(dim, 1, name=f"lvl{lvl}_conv1", dtype=self.dtype)(x))
+            x = _lrelu(_conv(dim, 1, name=f"lvl{lvl}_conv2", dtype=self.dtype)(x))
             feats.append(x)
         return feats
 
 
 class Refiner(nn.Module):
     """Dilated-conv context network added to the level-2 flow
-    (ref pwc_net.py:189-211)."""
+    (ref pwc_net.py:189-211). Convs in ``dtype``; the 2-channel flow
+    delta it emits returns fp32 (it lands on the fp32 flow estimate)."""
+
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, feat: jnp.ndarray) -> jnp.ndarray:
         dims = ((128, 1), (128, 2), (128, 4), (96, 8), (64, 16), (32, 1))
         for i, (ch, dil) in enumerate(dims):
-            feat = _lrelu(_conv(ch, dilation=dil, name=f"conv{i}")(feat))
-        return _conv(2, name="conv6")(feat)
+            feat = _lrelu(
+                _conv(ch, dilation=dil, name=f"conv{i}", dtype=self.dtype)(feat)
+            )
+        return _conv(2, name="conv6", dtype=self.dtype)(feat).astype(jnp.float32)
 
 
 class PWCNet(nn.Module):
     """(T, H, W, 3) RGB floats in [0,255] -> (T-1, H, W, 2) flow for each
-    consecutive frame pair, at input resolution."""
+    consecutive frame pair, at input resolution.
+
+    ``dtype=bfloat16`` selects the mixed-precision graph (module
+    docstring); the returned flow is always fp32."""
+
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, frames: jnp.ndarray) -> jnp.ndarray:
@@ -188,15 +227,17 @@ class PWCNet(nn.Module):
             -1,
         )
 
-        pyramid = Extractor(name="extractor")(x)
+        pyramid = Extractor(dtype=self.dtype, name="extractor")(x)
 
         prev = None
         for level in (6, 5, 4, 3, 2):
             f = pyramid[level - 1]
-            prev = Decoder(level, name=f"decoder{level}")(f[:-1], f[1:], prev)
+            prev = Decoder(level, dtype=self.dtype, name=f"decoder{level}")(
+                f[:-1], f[1:], prev
+            )
 
         flow, feat = prev
-        flow = flow + Refiner(name="refiner")(feat)
+        flow = flow + Refiner(dtype=self.dtype, name="refiner")(feat)
 
         flow = jnp.moveaxis(
             resize_bilinear(jnp.moveaxis(flow, -1, -3), (H, W), align_corners=False),
@@ -207,8 +248,8 @@ class PWCNet(nn.Module):
         return 20.0 * flow * scale
 
 
-def build() -> PWCNet:
-    return PWCNet()
+def build(dtype=jnp.float32) -> PWCNet:
+    return PWCNet(dtype=dtype)
 
 
 def init_params(seed: int = 0):
