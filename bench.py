@@ -156,6 +156,39 @@ def bench_i3d_raft(video: str, tmp: str) -> float:
     return _pass_stats(1, times)
 
 
+def bench_i3d_short_corpus(videos, tmp: str, video_batch: int) -> dict:
+    """The reference's worst case: a corpus of SHORT clips (one 65-frame
+    stack each) on the deepest pipeline, one tiny dispatch per video.
+    --video_batch fuses stacks across videos into the --batch_size group
+    executable (r4); video_batch=1 is the solo comparison."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+    from video_features_tpu.parallel.devices import resolve_devices
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="i3d",
+        flow_type="raft",
+        video_paths=list(videos),
+        batch_size=I3D_STACK_BATCH,
+        video_batch=video_batch,
+        tmp_path=os.path.join(tmp, f"t{video_batch}"),
+        output_path=os.path.join(tmp, f"o{video_batch}"),
+    )
+    ex = ExtractI3D(cfg, external_call=True)
+    ex.progress.disable = True
+    device = resolve_devices(cfg)[0]
+    ex(range(len(videos)), device=device)  # warmup compile
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        rs = ex(range(len(videos)), device=device)
+        times.append(time.perf_counter() - t0)
+    assert len(rs) == len(videos)
+    assert all(r["rgb"].shape == (1, 1024) for r in rs)
+    return _pass_stats(len(videos), times)
+
+
 def bench_pallas_corr() -> dict:
     """PWC 81-channel cost volume: Pallas kernel vs XLA formulation on the
     hottest PWC shape (level 2: 64 pairs, 32ch, 64x64 — the level 'auto'
@@ -539,10 +572,33 @@ def _sub_i3d_e2e() -> dict:
     }
 
 
+def _sub_i3d_agg() -> dict:
+    from video_features_tpu.utils.synth import synth_video
+
+    with tempfile.TemporaryDirectory() as tmp:
+        videos = [
+            synth_video(
+                os.path.join(tmp, f"s{i}.mp4"), n_frames=66,
+                width=256, height=256, seed=i,
+            )
+            for i in range(4)
+        ]
+        solo = bench_i3d_short_corpus(videos, tmp, video_batch=1)
+        agg = bench_i3d_short_corpus(videos, tmp, video_batch=4)
+    return {
+        "i3d_agg_vps": agg["best"],
+        "i3d_agg_median_vps": agg["median"],
+        "i3d_agg_passes": agg["passes"],
+        "i3d_solo_short_vps": solo["best"],
+        "i3d_agg_speedup_vs_solo": round(agg["best"] / solo["best"], 3),
+    }
+
+
 SUB_PARTS = {
     "clip_device_only": lambda: bench_clip_device_only(),
     "i3d_device_only": lambda: bench_i3d_device_only(),
     "i3d_e2e": _sub_i3d_e2e,
+    "i3d_agg": _sub_i3d_agg,
     "pallas_corr": lambda: bench_pallas_corr(),
     "flash_attention": lambda: bench_flash_attention(),
 }
@@ -663,6 +719,7 @@ def main() -> None:
     extra.update(_spawn_sub("pallas_corr", sub_timeout))
     if os.environ.get("BENCH_SKIP_I3D") != "1":
         extra.update(_spawn_sub("i3d_e2e", sub_timeout))
+        extra.update(_spawn_sub("i3d_agg", sub_timeout))
         extra.update(_spawn_sub("i3d_device_only", sub_timeout))
     if os.environ.get("BENCH_FLASH") == "1":
         # opt-in even in isolation: the L=4096 flash Mosaic compile has
