@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Fetch a model's public pretrained checkpoint, then convert it.
+
+The extract path itself never touches the network (air-gapped pods);
+this script is the opt-in convenience the reference gets from pip
+``clip.load`` / torch-hub auto-download (ref models/CLIP/
+extract_clip.py:46-63, models/vggish_torch/extract_vggish.py:22-27):
+
+    python scripts/fetch_weights.py CLIP-ViT-B/32 --dest weights/
+    python scripts/fetch_weights.py vggish_torch --dest weights/
+    python scripts/fetch_weights.py pwc --dest weights/
+    python scripts/fetch_weights.py i3d --dest weights/   # rgb + flow
+
+Each entry downloads the SAME file the reference consumes (sources in
+docs/weights.md) and invokes scripts/convert_weights.py on it. Models
+whose upstream needs an interactive step (RAFT's models.zip, the
+torchvision zoo, the TF1 vggish ckpt) print the documented manual
+recipe instead of guessing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# feature_type -> [(url, filename)]; converter feature_type defaults to
+# the key (i3d converts each stream file separately)
+SOURCES = {
+    "CLIP-ViT-B/32": [(
+        "https://openaipublic.azureedge.net/clip/models/"
+        "40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af/"
+        "ViT-B-32.pt",
+        "ViT-B-32.pt",
+    )],
+    "CLIP-ViT-B/16": [(
+        "https://openaipublic.azureedge.net/clip/models/"
+        "5806e77cd80f8b59890b7e101eabd078d9fb84e6937f9e85e4ecb61988df416f/"
+        "ViT-B-16.pt",
+        "ViT-B-16.pt",
+    )],
+    "vggish_torch": [(
+        "https://github.com/harritaylor/torchvggish/releases/download/"
+        "v0.1/vggish-10086976.pth",
+        "vggish-10086976.pth",
+    )],
+    "pwc": [(
+        "http://content.sniklaus.com/github/pytorch-pwc/"
+        "network-default.pytorch",
+        "network-default.pytorch",
+    )],
+    "i3d": [
+        (
+            "https://github.com/hassony2/kinetics_i3d_pytorch/raw/master/"
+            "model/model_rgb.pth",
+            "model_rgb.pth",
+        ),
+        (
+            "https://github.com/hassony2/kinetics_i3d_pytorch/raw/master/"
+            "model/model_flow.pth",
+            "model_flow.pth",
+        ),
+    ],
+}
+
+MANUAL = {
+    "raft": "download princeton-vl/RAFT's models.zip and unzip "
+            "raft-sintel.pth — see docs/weights.md",
+    "resnet18": "torchvision zoo — see docs/weights.md",
+    "resnet50": "torchvision zoo — see docs/weights.md",
+    "r21d_rgb": "torchvision zoo — see docs/weights.md",
+    "vggish": "TF1 AudioSet ckpt needs a TF export step — see docs/weights.md",
+}
+
+
+def fetch(url: str, dest: str, opener=None) -> str:
+    """Download ``url`` to ``dest`` (skip if present); return the path."""
+    if opener is None:  # resolved at call time so tests can monkeypatch
+        opener = urllib.request.urlopen
+    if os.path.exists(dest) and os.path.getsize(dest) > 0:
+        print(f"already present: {dest}")
+        return dest
+    print(f"fetching {url}")
+    tmp = dest + ".part"
+    with opener(url) as r, open(tmp, "wb") as f:
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+    os.replace(tmp, dest)  # atomic: no truncated file left behind on Ctrl-C
+    return dest
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("feature_type", choices=sorted(SOURCES | MANUAL.keys()))
+    p.add_argument("--dest", default="weights")
+    p.add_argument("--skip-convert", action="store_true",
+                   help="download only (convert needs the [convert] extra)")
+    args = p.parse_args(argv)
+
+    if args.feature_type in MANUAL:
+        print(f"{args.feature_type}: no direct URL — {MANUAL[args.feature_type]}")
+        return 1
+
+    os.makedirs(args.dest, exist_ok=True)
+    rc = 0
+    for url, fname in SOURCES[args.feature_type]:
+        src = fetch(url, os.path.join(args.dest, fname))
+        if args.skip_convert:
+            continue
+        dst = os.path.join(
+            args.dest,
+            os.path.splitext(fname)[0].replace("/", "-") + ".msgpack",
+        )
+        cmd = [sys.executable, os.path.join(HERE, "convert_weights.py"),
+               "--feature_type", args.feature_type, src, dst]
+        print(" ".join(cmd))
+        rc |= subprocess.call(cmd)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

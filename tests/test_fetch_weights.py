@@ -1,0 +1,61 @@
+"""scripts/fetch_weights.py: the opt-in download convenience (VERDICT
+r03 missing #3). Network is mocked — this sandbox has zero egress; what
+matters is the contract: URL registry sanity, atomic skip-if-present
+downloads, manual-recipe models refusing with a pointer."""
+
+import io
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "scripts"))
+
+import fetch_weights as fw
+
+
+def test_url_registry_matches_reference_sources():
+    for ft, entries in fw.SOURCES.items():
+        for url, fname in entries:
+            assert url.startswith(("https://", "http://")), url
+            assert any(
+                host in url
+                for host in (
+                    "openaipublic.azureedge.net",  # pip clip's blobs
+                    "github.com/harritaylor/torchvggish",  # ref vggish_torch
+                    "content.sniklaus.com",  # ref pwc checkpoint README
+                    "github.com/hassony2/kinetics_i3d_pytorch",  # ref i3d
+                )
+            ), url
+            assert fname == fname.strip("/")
+    # every feature type is either fetchable or documented-manual
+    assert set(fw.MANUAL) & set(fw.SOURCES) == set()
+
+
+def test_fetch_writes_atomically_and_skips_existing(tmp_path):
+    dest = tmp_path / "w.pt"
+    calls = []
+
+    def opener(url):
+        calls.append(url)
+        return io.BytesIO(b"checkpoint-bytes")
+
+    got = fw.fetch("http://example/w.pt", str(dest), opener=opener)
+    assert got == str(dest)
+    assert dest.read_bytes() == b"checkpoint-bytes"
+    assert not (tmp_path / "w.pt.part").exists()
+    # second call: present -> no network
+    fw.fetch("http://example/w.pt", str(dest), opener=opener)
+    assert calls == ["http://example/w.pt"]
+
+
+def test_manual_models_refuse_with_pointer(capsys):
+    assert fw.main(["raft", "--dest", "x"]) == 1
+    assert "docs/weights.md" in capsys.readouterr().out
+
+
+def test_download_only_flow(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        fw.urllib.request, "urlopen", lambda url: io.BytesIO(b"pt-bytes")
+    )
+    rc = fw.main(["pwc", "--dest", str(tmp_path), "--skip-convert"])
+    assert rc == 0
+    assert (tmp_path / "network-default.pytorch").read_bytes() == b"pt-bytes"
