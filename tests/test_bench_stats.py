@@ -101,3 +101,31 @@ def test_host_pipeline_bench_runs_on_cpu():
     assert out["host_decode_cv2_fps"] > 0
     assert out["host_preprocess_pil_fps"] > 0
     assert any(k.startswith("host_decode_workers_") for k in out)
+
+
+def test_i3d_short_corpus_wrapper_logic(monkeypatch, tmp_path):
+    """bench_i3d_short_corpus's wrapper code (cfg construction, warmup +
+    timed passes, shape assertion, stats) must not run for the FIRST time
+    during the tunnel window — same de-risking as the device-only smoke.
+    The extractor itself is stubbed; its real aggregation math is pinned
+    by tests/test_aggregation.py."""
+    import numpy as np
+
+    import bench
+    import video_features_tpu.models.i3d.extract_i3d as mod
+
+    class StubExtractor:
+        def __init__(self, cfg, external_call=False):
+            self.cfg = cfg
+            self.progress = type("P", (), {"disable": False})()
+
+        def __call__(self, idxs, device=None):
+            return [
+                {"rgb": np.zeros((1, 1024)), "flow": np.zeros((1, 1024))}
+                for _ in idxs
+            ]
+
+    monkeypatch.setattr(mod, "ExtractI3D", StubExtractor)
+    videos = [str(tmp_path / f"v{i}.mp4") for i in range(4)]
+    stats = bench.bench_i3d_short_corpus(videos, str(tmp_path), video_batch=4)
+    assert stats["best"] > 0 and len(stats["passes"]) == 2
