@@ -25,7 +25,11 @@ from video_features_tpu.io.paths import video_path_of
 from video_features_tpu.io.video import extract_frames
 from video_features_tpu.models.clip.convert import convert_state_dict
 from video_features_tpu.models.clip.model import CONFIGS, VisionTransformer, init_params
-from video_features_tpu.models.common.weights import load_params, random_init_fallback
+from video_features_tpu.models.common.weights import (
+    compute_dtype,
+    load_params,
+    random_init_fallback,
+)
 from video_features_tpu.ops.preprocess import (
     CLIP_MEAN,
     CLIP_STD,
@@ -185,6 +189,14 @@ class ExtractCLIP(BaseExtractor):
         batch = self._preprocess_frames(frames)  # (T, 3, H, W)
         T = batch.shape[0]
         padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
+        if compute_dtype(self.config) != jnp.float32:
+            # pre-cast on the host (decode-worker) thread: the ViT's first
+            # conv casts inputs to bf16 anyway, so numerics are identical,
+            # and the host->device transfer halves — which matters when
+            # dispatch rides a tunnel/DCN
+            import ml_dtypes
+
+            padded = padded.astype(ml_dtypes.bfloat16)
         return padded, T, fps, timestamps_ms
 
     # device half, split for the device pipeline (extract/base.py): enqueue
