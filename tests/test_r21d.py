@@ -186,3 +186,27 @@ def test_extract_r21d_show_pred(sample_video, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "@ frames (0, 32)" in out
     assert res[0]["r21d_rgb"].shape == (1, 512)
+
+
+def test_uint8_transfer_off_matches_on(sample_video, tmp_path):
+    """--uint8_transfer off (host-side fp32 pre-cast, the slow-uint8-DMA
+    escape hatch) must be numerically identical to the uint8 path —
+    kinetics_preprocess starts with the same fp32 cast either way."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+
+    def run(mode):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="r21d_rgb",
+            video_paths=[sample_video],
+            uint8_transfer=mode,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+        ex = ExtractR21D(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0])[0]["r21d_rgb"]
+
+    np.testing.assert_array_equal(run("on"), run("off"))

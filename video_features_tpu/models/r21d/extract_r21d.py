@@ -141,6 +141,13 @@ class ExtractR21D(BaseExtractor):
     # window batch's transfer + fused preprocess/forward is dispatched
     # (async under XLA), results stay on device until fetch — the next
     # video's dispatches overlap this video's fetch
+    def _maybe_widen(self, x: np.ndarray) -> np.ndarray:
+        """--uint8_transfer off: pre-cast windows to fp32 host-side — the
+        escape hatch for transports with a slow uint8 DMA path
+        (config.py). kinetics_preprocess starts with an fp32 cast, so
+        numerics are identical either way."""
+        return x.astype(np.float32) if self.config.uint8_transfer == "off" else x
+
     def dispatch_prepared(self, device, state, path_entry, payload):
         batches, slices = payload
         if not slices:
@@ -149,7 +156,7 @@ class ExtractR21D(BaseExtractor):
 
         outs = []
         for padded, n in batches:
-            padded = pad_batch_for(state["device"], padded)
+            padded = pad_batch_for(state["device"], self._maybe_widen(padded))
             x = place_batch(padded, state["device"])
             feats, logits = state["forward"](state["params"], x)
             # drop logits unless show_pred needs them — the handle pins
@@ -184,7 +191,7 @@ class ExtractR21D(BaseExtractor):
         group = max(int(self.config.video_batch or 1), 1)
         stacks, totals = [], []  # rows = uint8 window stacks here
         for batches, slices in payloads:
-            stacks.extend(x[:n] for x, n in batches)
+            stacks.extend(self._maybe_widen(x[:n]) for x, n in batches)
             totals.append(len(slices))
         outs = self._dispatch_rows_grouped(state, stacks, self.batch_size * group)
         return outs, totals
