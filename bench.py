@@ -127,7 +127,7 @@ def bench_clip(
     return _pass_stats(n_videos, times)
 
 
-def bench_i3d_raft(video: str, tmp: str) -> float:
+def bench_i3d_raft(video: str, tmp: str, flow_type: str = "raft") -> float:
     from video_features_tpu.config import ExtractionConfig
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.parallel.devices import resolve_devices
@@ -135,13 +135,13 @@ def bench_i3d_raft(video: str, tmp: str) -> float:
     cfg = ExtractionConfig(
         allow_random_init=True,
         feature_type="i3d",
-        flow_type="raft",
+        flow_type=flow_type,
         video_paths=[video],
         # --batch_size 2: both of the video's 64-frame stacks fuse into
         # one RAFT+I3D dispatch (models/i3d stack batching)
         batch_size=I3D_STACK_BATCH,
-        tmp_path=os.path.join(tmp, "t"),
-        output_path=os.path.join(tmp, "o"),
+        tmp_path=os.path.join(tmp, "t" + flow_type),
+        output_path=os.path.join(tmp, "o" + flow_type),
     )
     ex = ExtractI3D(cfg, external_call=True)
     ex.progress.disable = True
@@ -565,10 +565,15 @@ def _sub_i3d_e2e() -> dict:
     with tempfile.TemporaryDirectory() as tmp:
         video = synth_video(os.path.join(tmp, "i3d.mp4"), **I3D_SPEC)
         i3d = bench_i3d_raft(video, tmp)
+        # the reference's one qualitative perf claim is "PWC is faster
+        # while RAFT is more accurate" (ref main.py:123-124) — measure it
+        pwc = bench_i3d_raft(video, tmp, flow_type="pwc")
     return {
         "i3d_raft_vps": i3d["best"],
         "i3d_raft_median_vps": i3d["median"],
         "i3d_raft_passes": i3d["passes"],
+        "i3d_pwc_vps": pwc["best"],
+        "i3d_pwc_median_vps": pwc["median"],
     }
 
 
