@@ -21,9 +21,8 @@ from video_features_tpu.ops.attention import attention
 from video_features_tpu.ops.pallas.flash_attention import flash_attention
 
 
-def main() -> None:
-    assert jax.default_backend() == "tpu", jax.default_backend()
-    N, H, L, d = 1, 12, 4096, 64
+def validate(L: int) -> None:
+    N, H, d = 1, 12, 64
     rng = np.random.RandomState(0)
     q, k, v = (
         jnp.asarray(rng.randn(N, H, L, d).astype(np.float32)) for _ in range(3)
@@ -31,20 +30,31 @@ def main() -> None:
     t0 = time.perf_counter()
     out = flash_attention(q, k, v)
     out.block_until_ready()
-    print(f"flash compile+run: {time.perf_counter() - t0:.2f} s")
+    print(f"L={L} flash compile+run: {time.perf_counter() - t0:.2f} s", flush=True)
     t0 = time.perf_counter()
     out = np.asarray(flash_attention(q, k, v))
-    print(f"flash steady (incl fetch): {time.perf_counter() - t0 :.3f} s")
+    print(f"L={L} flash steady (incl fetch): {time.perf_counter() - t0 :.3f} s",
+          flush=True)
     fused = jax.jit(attention)
     ref = fused(q, k, v)
     ref.block_until_ready()
     t0 = time.perf_counter()
     ref = np.asarray(fused(q, k, v))
-    print(f"fused steady (incl fetch): {time.perf_counter() - t0:.3f} s")
+    print(f"L={L} fused steady (incl fetch): {time.perf_counter() - t0:.3f} s",
+          flush=True)
     err = float(np.abs(out - ref).max())
-    print(f"max abs diff: {err:.2e}")
+    print(f"L={L} max abs diff: {err:.2e}", flush=True)
     assert err < 1e-4, err
-    print("ok")
+    print(f"L={L} ok", flush=True)
+
+
+def main() -> None:
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    # tiered: the small Mosaic grid compiles first, so if the L=4096
+    # compile takes the helper down (observed 2026-07-30) the artifact
+    # still proves the kernel's compiled path ran on hardware
+    validate(512)
+    validate(4096)
 
 
 if __name__ == "__main__":
