@@ -24,7 +24,10 @@ echo "== 2/3 bench (both north-star configs) =="
 # window's CLIP numbers died with the process on the I3D compile —
 # bench.py is now subprocess-isolated per part, but the copy costs
 # nothing and makes the evidence durable either way)
-python bench.py | tee /tmp/bench_r04_local.json || {
+# BENCH_BF16=1: the r4 story is mixed precision — capture the bf16 CLIP
+# e2e variant too (one extra XLA compile; the i3d bf16 figures are
+# already part of bench_i3d_device_only)
+BENCH_BF16=1 python bench.py | tee /tmp/bench_r04_local.json || {
   echo "bench FAILED (rc=$?) — no numbers captured; NOT proceeding to the"
   echo "helper-crash-risk flash compile. Re-run when the relay is stable."
   exit 1; }
