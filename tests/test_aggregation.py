@@ -620,3 +620,21 @@ def test_i3d_aggregation_isolates_bad_video(four_videos, tmp_path, capsys):
     assert "An error occurred" in capsys.readouterr().out
     for r in fused:
         assert r["rgb"].shape == (1, 1024)
+
+
+def test_flow_one_frame_video_routes_solo(tmp_path):
+    """A 1-frame video makes zero pairs hence zero windows: agg_key must
+    decline (not IndexError) and the solo path must return the empty flow
+    array — same contract as video_batch=1 (code-review r04)."""
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+    from video_features_tpu.utils.synth import synth_video
+
+    one = synth_video(str(tmp_path / "one.mp4"), n_frames=1, width=96, height=64)
+    ex = ExtractPWC(
+        _flow_cfg("pwc", [one], tmp_path, video_batch=2), external_call=True
+    )
+    payload = ex.prepare(one)
+    assert payload[0] == [] or payload[0] == "stream" or len(payload[0]) == 0
+    assert ex.agg_key(payload) is None
+    (res,) = ex()
+    assert res["pwc"].shape[0] == 0

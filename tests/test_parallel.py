@@ -469,3 +469,25 @@ def test_mesh_i3d_sequence_parallel_matches_single_device(sample_video, tmp_path
     sharded = run(mesh)
     assert single["rgb"].shape == sharded["rgb"].shape == (3, 1024)
     np.testing.assert_allclose(sharded["rgb"], single["rgb"], atol=2e-4)
+
+
+def test_multihost_out_kwargs_replicates_only_on_multiprocess(monkeypatch):
+    """Single-host mesh: {} (propagation keeps the flow nets' off-by-one
+    output axis legal). Multi-controller: every output pinned replicated
+    so np.asarray works on all hosts (code-review r04)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from video_features_tpu.parallel.sharding import (
+        make_mesh,
+        multihost_out_kwargs,
+    )
+
+    mesh = make_mesh(jax.devices(), model=1)
+    assert multihost_out_kwargs(mesh) == {}
+    assert multihost_out_kwargs(jax.devices()[0]) == {}
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    kw = multihost_out_kwargs(mesh)
+    assert kw["out_shardings"].spec == P()
+    assert multihost_out_kwargs(jax.devices()[0]) == {}

@@ -210,3 +210,32 @@ def test_uint8_transfer_off_matches_on(sample_video, tmp_path):
         return ex([0])[0]["r21d_rgb"]
 
     np.testing.assert_array_equal(run("on"), run("off"))
+
+
+def test_agg_cap_accounts_for_widened_transfer(sample_video, tmp_path):
+    """--uint8_transfer off widens fused rows to fp32, so the AGG byte cap
+    must budget 4 bytes/element — a payload admitted under uint8 near the
+    cap must be declined when widened (code-review r04)."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.r21d.extract_r21d import ExtractR21D
+
+    def make(mode):
+        return ExtractR21D(
+            ExtractionConfig(
+                allow_random_init=True,
+                feature_type="r21d_rgb",
+                video_paths=[sample_video],
+                uint8_transfer=mode,
+                cpu=True,
+            ),
+            external_call=True,
+        )
+
+    # fabricated payload just under the uint8 cap: one batch of shape
+    # (1, stack, H, W, 3) with enough slices that uint8 fits, fp32 not
+    stack = np.zeros((1, 16, 160, 160, 3), np.uint8)
+    per_slice = int(np.prod(stack.shape[1:]))  # ~1.2 MB in uint8 units
+    n_slices = (ExtractR21D.AGG_MAX_BYTES // per_slice) - 1
+    payload = ([(stack, 1)], [(0, 16)] * n_slices)
+    assert make("on").agg_key(payload) is not None
+    assert make("off").agg_key(payload) is None

@@ -76,7 +76,23 @@ class BaseExtractor:
             self.config.on_extraction,
             self.config.output_direct,
         )
-        return bool(files) and all(os.path.exists(f) for f in files)
+        done = bool(files) and all(os.path.exists(f) for f in files)
+        # Multi-host: only process 0 writes (see _sink_or_collect), so a
+        # per-process local probe DIVERGES on per-host filesystems — and
+        # every sharded dispatch is collective, so one process skipping a
+        # video the others compute is a deadlock. All processes take
+        # process 0's answer; this broadcast is itself a collective, which
+        # is safe exactly because every process probes every video in the
+        # same order.
+        from video_features_tpu.parallel.sharding import multihost
+
+        if multihost():
+            from jax.experimental import multihost_utils
+
+            done = bool(
+                multihost_utils.broadcast_one_to_all(np.int32(done))
+            )
+        return done
 
     # --- native host-preprocess decision (shared by the PIL-chain
     # extractors: ResNet's bilinear chain, CLIP's bicubic chain) ----------

@@ -111,8 +111,12 @@ class PairwiseFlowExtractor(BaseExtractor):
 
         # plain jit even on the mesh: the B-pair output length is one
         # short of the (data-divisible) frame axis, and explicit
-        # out_shardings require divisibility — propagation handles it
-        forward = jax.jit(forward)
+        # out_shardings require divisibility — propagation handles it.
+        # EXCEPT multi-host, where outputs pin replicated so every
+        # process can fetch them (sharding.py::multihost_out_kwargs)
+        from video_features_tpu.parallel.sharding import multihost_out_kwargs
+
+        forward = jax.jit(forward, **multihost_out_kwargs(device))
 
         # --video_batch fused path: G whole windows forward as one call,
         # vmapped over the window axis (each window is an independent
@@ -126,7 +130,9 @@ class PairwiseFlowExtractor(BaseExtractor):
         return {
             "params": params,
             "forward": forward,
-            "forward_group": jax.jit(forward_group),
+            "forward_group": jax.jit(
+                forward_group, **multihost_out_kwargs(device)
+            ),
             "device": device,
         }
 
@@ -325,6 +331,10 @@ class PairwiseFlowExtractor(BaseExtractor):
         if payload[0] == "stream":
             return None
         windows = payload[0]
+        # a 1-frame video makes zero pairs, hence zero windows — nothing
+        # to fuse; the solo path returns its empty flow array
+        if not windows:
+            return None
         if len(windows) * windows[0].nbytes > self.AGG_MAX_BYTES:
             return None
         return windows[0].shape  # (B+1, Hp, Wp, 3)

@@ -36,6 +36,7 @@ Output contract: ``{rgb: (S, 1024), flow: (S, 1024), fps, timestamps_ms}``
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Dict, List
 
@@ -233,14 +234,22 @@ class ExtractI3D(BaseExtractor):
             # throughput knob)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from video_features_tpu.parallel.sharding import (
+                multihost_out_kwargs,
+            )
+
             seq = NamedSharding(state["device"], P("data"))
+            # multi-host: outputs pin replicated so every process can
+            # fetch (sharding.py::multihost_out_kwargs); single-host
+            # keeps propagation
+            mh = multihost_out_kwargs(state["device"])
 
             def shard_seq(stack):
                 return jax.lax.with_sharding_constraint(stack, seq)
 
             if "rgb" in self.streams:
 
-                @jax.jit
+                @functools.partial(jax.jit, **mh)
                 def rgb_fn(p, stack):  # (S+1, H, W, 3) raw [0,255] floats
                     # stack[:-1] in EVERY mode: with pre-extracted flow
                     # the window is stack_size, so rgb runs on
@@ -256,7 +265,7 @@ class ExtractI3D(BaseExtractor):
                     shape, state.get("dtype", jnp.float32)
                 )
 
-                @jax.jit
+                @functools.partial(jax.jit, **mh)
                 def flow_fn(p_flow, p_i3d, stack):
                     padded = jnp.pad(
                         shard_seq(stack), ((0, 0), (t, b), (l, r), (0, 0)),
@@ -271,7 +280,7 @@ class ExtractI3D(BaseExtractor):
 
                 pwc = pwc_build(dtype=state.get("dtype", jnp.float32))
 
-                @jax.jit
+                @functools.partial(jax.jit, **mh)
                 def flow_fn(p_flow, p_i3d, stack):
                     flow = pwc.apply({"params": p_flow}, shard_seq(stack))
                     return i3d.apply({"params": p_i3d}, flow_chain(flow)[None])
@@ -279,7 +288,7 @@ class ExtractI3D(BaseExtractor):
                 fns["flow"] = flow_fn
             elif "flow" in self.streams and self.flow_type == "flow":
 
-                @jax.jit
+                @functools.partial(jax.jit, **mh)
                 def flow_fn(p_i3d, flow_imgs):  # (S, H', W', 2) as floats
                     f = disk_flow_chain(shard_seq(flow_imgs))
                     return i3d.apply({"params": p_i3d}, f[None])

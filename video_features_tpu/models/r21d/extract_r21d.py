@@ -183,7 +183,10 @@ class ExtractR21D(BaseExtractor):
         if not slices:
             return None
         shape = batches[0][0].shape  # (batch_size, stack, H, W, 3)
-        if len(slices) * int(np.prod(shape[1:])) > self.AGG_MAX_BYTES:
+        # budget in TRANSFER bytes: --uint8_transfer off widens rows to
+        # fp32 before the fused dispatch, 4x the uint8 element count
+        elem = 4 if self.config.uint8_transfer == "off" else 1
+        if len(slices) * int(np.prod(shape[1:])) * elem > self.AGG_MAX_BYTES:
             return None
         return shape
 

@@ -159,6 +159,20 @@ def pad_batch_for(device, batch: np.ndarray) -> np.ndarray:
     return batch
 
 
+def multihost_out_kwargs(device) -> dict:
+    """``jax.jit`` kwargs pinning every output replicated on a mesh under
+    a multi-controller runtime — extractors that jit with plain
+    propagation (flow nets, i3d's per-shape fns) would otherwise fetch
+    cross-host-sharded arrays, and ``np.asarray`` on one raises "not
+    fully addressable" on every host. Single-host / non-mesh: {} (keep
+    propagation: the flow nets' B-pair output axis is one short of the
+    data-divisible frame axis, where an explicit 'data' sharding would be
+    rejected)."""
+    if is_mesh(device) and multihost():
+        return {"out_shardings": NamedSharding(device, P())}
+    return {}
+
+
 def jit_sharded_forward(fn, device, n_out: int = 1):
     """jit ``fn(params, x)`` for either execution mode: plain jit on a
     single device; on a Mesh, pin each output to P('data') so results come
