@@ -303,8 +303,7 @@ def bench_host_pipeline() -> dict:
     from video_features_tpu.utils.synth import synth_video
 
     out = {}
-    tmp_ctx = tempfile.TemporaryDirectory()
-    with tmp_ctx as tmp:
+    with tempfile.TemporaryDirectory() as tmp:
         video = synth_video(os.path.join(tmp, "host.mp4"), **CLIP_SPEC)
 
         def decode_all(backend):

@@ -91,6 +91,15 @@ def multihost() -> bool:
     return jax.process_count() > 1
 
 
+def _mesh_out_sharding(mesh: Mesh, spec) -> NamedSharding:
+    """THE decision point for pinned mesh output placement: the caller's
+    spec single-host, REPLICATED (an all-gather at graph exit) under
+    multi-host — ``np.asarray`` on a cross-host-sharded global array
+    raises "not fully addressable" on every host, and features are tiny
+    next to activations, so the gather is noise."""
+    return NamedSharding(mesh, P() if multihost() else spec)
+
+
 def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data"),
                         out_spec=P("data")):
     """jit ``model.apply`` with the batch sharded over 'data'.
@@ -99,14 +108,10 @@ def build_sharded_apply(model, mesh: Mesh, batch_spec=P("data"),
     ``shard_params`` (their shardings flow into the jit as arguments).
     ``--mesh_context`` mode passes ``P()`` for both: the batch replicates
     and the token axis shards *inside* the model via ring attention.
-
-    Multi-host: outputs come back REPLICATED (an all-gather at graph
-    exit) instead of batch-sharded — ``np.asarray`` on a 'data'-sharded
-    global array raises "not fully addressable" on every host, and
-    features are tiny next to activations, so the gather is noise.
+    Output placement: ``_mesh_out_sharding``.
     """
     x_sharding = NamedSharding(mesh, batch_spec)
-    out_sharding = NamedSharding(mesh, P() if multihost() else out_spec)
+    out_sharding = _mesh_out_sharding(mesh, out_spec)
 
     @partial(jax.jit, out_shardings=out_sharding)
     def fn(p, x):
@@ -175,12 +180,11 @@ def multihost_out_kwargs(device) -> dict:
 
 def jit_sharded_forward(fn, device, n_out: int = 1):
     """jit ``fn(params, x)`` for either execution mode: plain jit on a
-    single device; on a Mesh, pin each output to P('data') so results come
-    back batch-sharded (params/input shardings flow in as arguments).
-    Multi-host pins outputs replicated instead — see build_sharded_apply."""
+    single device; on a Mesh, pin each output per ``_mesh_out_sharding``
+    ('data'-sharded single-host, replicated multi-host)."""
     if not is_mesh(device):
         return jax.jit(fn)
-    out = NamedSharding(device, P() if multihost() else P("data"))
+    out = _mesh_out_sharding(device, P("data"))
     return jax.jit(fn, out_shardings=out if n_out == 1 else (out,) * n_out)
 
 
