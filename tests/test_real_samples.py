@@ -206,3 +206,33 @@ def test_sample_video_paths_txt_round_trip(tmp_path):
     )
     paths = form_list_from_user_input(cfg)
     assert [str(pathlib.Path(p)) for p in paths] == SAMPLES
+
+
+def test_pwc_video_batch_on_real_samples(tmp_path):
+    """Cross-video window fusion (r4) on the real H.264 stream: the same
+    clip twice shares one agg key, so windows fuse across the two
+    'videos'; features must reproduce the solo run's."""
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+
+    def run(video_batch):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="pwc",
+            extraction_fps=1.0,
+            batch_size=8,
+            video_paths=[SAMPLES[0], SAMPLES[0]],
+            video_batch=video_batch,
+            tmp_path=str(tmp_path / f"tmp{video_batch}"),
+            output_path=str(tmp_path / f"out{video_batch}"),
+            cpu=True,
+        )
+        ex = ExtractPWC(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex()
+
+    solo = run(1)
+    fused = run(2)
+    assert len(solo) == len(fused) == 2
+    for s, f in zip(solo, fused):
+        np.testing.assert_allclose(f["pwc"], s["pwc"], atol=1e-3, rtol=1e-3)
+        np.testing.assert_array_equal(f["timestamps_ms"], s["timestamps_ms"])
