@@ -638,3 +638,27 @@ def test_flow_one_frame_video_routes_solo(tmp_path):
     assert ex.agg_key(payload) is None
     (res,) = ex()
     assert res["pwc"].shape[0] == 0
+
+
+def test_flow_over_cap_video_streams_serially(three_flow_videos, tmp_path):
+    """A flow video over the prefetch byte budget must fall back to the
+    serial streaming loop (prepare -> ("stream", entry) ->
+    dispatch_prepared -> extract) and still produce identical features to
+    the prepared path."""
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+
+    normal = ExtractPWC(
+        _flow_cfg("pwc", three_flow_videos[:1], tmp_path), external_call=True
+    )
+    (want,) = normal()
+
+    capped = ExtractPWC(
+        _flow_cfg("pwc", three_flow_videos[:1], tmp_path), external_call=True
+    )
+    # the byte budget floors at 4 windows (a tiny budget still prefetches
+    # a little), so pin the cap itself below the 9-frame video
+    capped._window_cap = lambda frame: 4
+    assert capped.prepare(three_flow_videos[0])[0] == "stream"
+    (got,) = capped()
+    np.testing.assert_allclose(got["pwc"], want["pwc"], atol=1e-4, rtol=1e-4)
+    np.testing.assert_array_equal(got["timestamps_ms"], want["timestamps_ms"])
