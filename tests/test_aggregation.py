@@ -662,3 +662,30 @@ def test_flow_over_cap_video_streams_serially(three_flow_videos, tmp_path):
     (got,) = capped()
     np.testing.assert_allclose(got["pwc"], want["pwc"], atol=1e-4, rtol=1e-4)
     np.testing.assert_array_equal(got["timestamps_ms"], want["timestamps_ms"])
+
+
+def test_flow_aggregation_through_queue_scheduler(three_flow_videos, tmp_path):
+    """--video_batch on a flow extractor through parallel_feature_extraction
+    on TWO devices: the r4 fused-window dispatch_group runs inside the
+    multi-device queue branch (per-chip chunking, per-video output files)
+    with features matching the solo run."""
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+    from video_features_tpu.parallel.devices import resolve_devices
+    from video_features_tpu.parallel.scheduler import parallel_feature_extraction
+
+    cfg = _flow_cfg(
+        "pwc", three_flow_videos, tmp_path, video_batch=2,
+        on_extraction="save_numpy",
+    ).replace(cpu=False, device_ids=[0, 1])
+    devices = resolve_devices(cfg)
+    assert len(devices) == 2
+    ex = ExtractPWC(cfg)
+    parallel_feature_extraction(ex, devices)
+    saved = sorted(pathlib.Path(tmp_path / "out").rglob("*.npy"))
+    assert len(saved) == 3
+    solo = ExtractPWC(
+        _flow_cfg("pwc", three_flow_videos, tmp_path / "solo"),
+        external_call=True,
+    )()
+    for f, s in zip(saved, solo):  # both sorted by stem f0..f2
+        np.testing.assert_allclose(np.load(f), s["pwc"], atol=1e-3, rtol=1e-3)
