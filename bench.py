@@ -10,8 +10,14 @@ configs, printed as ONE JSON line.
 - extra.pallas_corr_speedup_vs_xla: the PWC cost-volume microbench, Pallas
   VMEM-tiled kernel vs the XLA shifted-reduce formulation (TPU backends
   only; omitted on CPU where the Pallas kernel has no fast path).
-- extra.clip_bf16_vps (BENCH_BF16=1, opt-in — costs a second compile):
-  the CLIP config re-run under --dtype bfloat16.
+- extra.clip_bf16_vps (default-on since r5; BENCH_BF16=0 to skip the
+  second compile): the CLIP config re-run under --dtype bfloat16.
+
+Every part runs in a child process and the complete-so-far JSON line is
+re-printed after each one — consumers should take the LAST parseable
+stdout line. A dead tunnel no longer zeroes the artifact: host-side
+numbers are measured before the backend probe, and the probe failure is
+recorded in-band under extra.fatal.
 
 ``vs_baseline`` ratios divide by MEASURED numbers — the reference's own
 torch code timed on this host's CPU by scripts/measure_baseline.py
@@ -63,6 +69,15 @@ def _load_measured_baselines() -> dict:
 # the headline CLIP config's sampler — one constant shared by the run and
 # its bench_config record
 CLIP_EXTRACT_METHOD = "uni_12"
+
+
+def _clip_group(n_videos: int) -> int:
+    """--video_batch for the headline run: capped at 8, never exceeding
+    the video count (a chronically-partial group pads to the full shape
+    and would burn that compute for nothing). ONE definition shared by
+    main's bench_config record and the clip sub-parts so the recorded
+    knob is always the one the measurement used."""
+    return min(8, max(n_videos, 1))
 # I3D window stacks fused per device call (the bench video yields 2)
 I3D_STACK_BATCH = 2
 # both north-star synth workloads, shared by main() and the --sub parts
@@ -549,13 +564,95 @@ def bench_i3d_device_only() -> dict:
     return out
 
 
-# Every device-touching part beyond the headline CLIP run executes in a
-# child process: the axon relay's compile helper has now died mid-bench in
+# EVERY device-touching part (headline included, r5) executes in a child
+# process: the axon relay's compile helper has now died mid-bench in
 # THREE rounds (r02/r03 outages; r04's first capture lost everything when
 # the I3D 3D-conv compile hit "UNAVAILABLE: TPU backend setup/compile
 # error" — the whole process died and the already-measured CLIP numbers
-# with it). A crash inside a part now costs exactly that part's keys.
+# with it). A crash inside a part now costs exactly that part's keys —
+# and main() re-prints the complete-so-far JSON line after every part,
+# so the LAST parseable stdout line is always the fullest artifact even
+# if the parent itself dies mid-run (VERDICT r4 next #1).
 _SUB_MARK = "BENCH_SUB "
+
+
+def _sub_clip_e2e() -> dict:
+    """The headline end-to-end CLIP config (aggregated + solo), isolated
+    in a child so a helper crash during ITS compile can't zero the run."""
+    from video_features_tpu.utils.synth import synth_video
+
+    n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
+    group = _clip_group(n_videos)
+    with tempfile.TemporaryDirectory() as tmp:
+        video = synth_video(os.path.join(tmp, "bench.mp4"), **CLIP_SPEC)
+        agg = bench_clip(n_videos, video, tmp, video_batch=group)
+        solo = bench_clip(n_videos, video, tmp)
+    return {
+        "clip_vps": agg["best"],
+        "clip_agg_median_vps": agg["median"],
+        "clip_agg_passes": agg["passes"],
+        "clip_solo_vps": solo["best"],
+        "clip_solo_median_vps": solo["median"],
+        "clip_solo_passes": solo["passes"],
+    }
+
+
+def _sub_clip_bf16() -> dict:
+    """--dtype bfloat16 e2e variant (one extra XLA compile)."""
+    from video_features_tpu.utils.synth import synth_video
+
+    n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
+    group = _clip_group(n_videos)
+    with tempfile.TemporaryDirectory() as tmp:
+        video = synth_video(os.path.join(tmp, "bench.mp4"), **CLIP_SPEC)
+        bf16 = bench_clip(n_videos, video, tmp, dtype="bfloat16", video_batch=group)
+    return {
+        "clip_bf16_vps": bf16["best"],
+        "clip_bf16_median_vps": bf16["median"],
+        "clip_bf16_passes": bf16["passes"],
+    }
+
+
+def _tiny_i3d_forward() -> float:
+    """Compile + run the full I3D graph at a tiny-but-real shape; returns
+    elapsed seconds. The conv lowering is whatever VFT_CONV3D_IMPL says."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.i3d.model import build, init_params
+
+    t0 = time.perf_counter()
+    model = build()
+    params = jax.device_put(init_params("rgb"))
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(1, 17, 224, 224, 3).astype(np.float32)
+    )
+    feats, logits = jax.jit(lambda p, x: model.apply({"params": p}, x))(params, x)
+    jax.block_until_ready((feats, logits))
+    return time.perf_counter() - t0
+
+
+def _sub_i3d_compile_probe() -> dict:
+    """Gate for the i3d parts (VERDICT r4 next #2): prove the chosen
+    conv3d lowering compiles the full I3D graph before any expensive i3d
+    part risks the relay. On TPU the parent pre-selects the decomposed
+    lowering (the direct one killed the compile helper in r2-r4)."""
+    from video_features_tpu.models.common.layers import conv3d_impl
+
+    return {
+        "i3d_conv3d_impl": conv3d_impl(),
+        "i3d_compile_probe_s": round(_tiny_i3d_forward(), 1),
+    }
+
+
+def _sub_conv3d_direct_probe() -> dict:
+    """DIAGNOSTIC, runs LAST: does the direct XLA 3D-conv lowering (the
+    r2-r4 helper-killer) compile today? Recorded after all numbers are
+    already persisted, so a crash here costs only this key — and the
+    answer is the committed repro datapoint scripts/repro_i3d_conv3d.py
+    exists to collect."""
+    os.environ["VFT_CONV3D_IMPL"] = "direct"
+    return {"conv3d_direct_compile_s": round(_tiny_i3d_forward(), 1)}
 
 
 def _sub_i3d_e2e() -> dict:
@@ -599,7 +696,11 @@ def _sub_i3d_agg() -> dict:
 
 
 SUB_PARTS = {
+    "clip_e2e": _sub_clip_e2e,
+    "clip_bf16": _sub_clip_bf16,
     "clip_device_only": lambda: bench_clip_device_only(),
+    "i3d_compile_probe": _sub_i3d_compile_probe,
+    "conv3d_direct_probe": _sub_conv3d_direct_probe,
     "i3d_device_only": lambda: bench_i3d_device_only(),
     "i3d_e2e": _sub_i3d_e2e,
     "i3d_agg": _sub_i3d_agg,
@@ -637,11 +738,13 @@ def _spawn_sub(name: str, timeout_s: float) -> dict:
     return {f"{name}_error": f"rc={proc.returncode}: " + " | ".join(tail)}
 
 
-def _probe_backend(timeout_s: float = 180.0) -> None:
+def _probe_backend(timeout_s: float = 180.0, fatal: bool = True) -> bool:
     """Fail fast if the TPU backend is unreachable. The axon tunnel's
     compile helper can die (observed 2026-07-30), after which
     jax.devices() blocks FOREVER — without this guard the whole bench
-    hangs instead of reporting an actionable error."""
+    hangs instead of reporting an actionable error. ``fatal=False``
+    (main, r5): report the outage in-band and let the caller emit an
+    artifact carrying the host-side numbers instead of dying with none."""
     import threading
 
     from video_features_tpu.parallel.devices import pin_platform
@@ -672,78 +775,47 @@ def _probe_backend(timeout_s: float = 180.0) -> None:
         )
         print(
             f"FATAL: jax.devices() {reason} — the TPU backend/tunnel is "
-            "unreachable (dead compile helper?). No benchmark numbers were "
-            "produced.",
+            "unreachable (dead compile helper?). No device benchmark "
+            "numbers were produced.",
             file=sys.stderr,
         )
-        os._exit(3)
+        if fatal:
+            os._exit(3)
+        return False
     print(f"backend ok: {devices}", file=sys.stderr)
+    return True
 
 
 def main() -> None:
-    from video_features_tpu.utils.synth import synth_video
-
-    _probe_backend()
-
     n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
     baselines = _load_measured_baselines()
-    extra = {}
-    with tempfile.TemporaryDirectory() as tmp:
-        clip_video = synth_video(os.path.join(tmp, "bench.mp4"), **CLIP_SPEC)
-        # headline: --video_batch 8 (cross-video aggregation, the shipped
-        # fast path); the unaggregated r01/r02-comparable number ships in
-        # extra.clip_solo_* alongside. Group size never exceeds the video
-        # count: a chronically-partial group pads to the full shape and
-        # would burn that compute for nothing.
-        group = min(8, max(n_videos, 1))
-        agg = bench_clip(n_videos, clip_video, tmp, video_batch=group)
-        clip_vps = agg["best"]
-        extra["clip_agg_median_vps"] = agg["median"]
-        extra["clip_agg_passes"] = agg["passes"]
-        solo = bench_clip(n_videos, clip_video, tmp)
-        extra["clip_solo_vps"] = solo["best"]
-        extra["clip_solo_median_vps"] = solo["median"]
-        extra["clip_solo_passes"] = solo["passes"]
-        if os.environ.get("BENCH_BF16") == "1":
-            # --dtype bfloat16 variant (opt-in: costs a second XLA compile)
-            bf16 = bench_clip(
-                n_videos, clip_video, tmp, dtype="bfloat16", video_batch=group
-            )
-            extra["clip_bf16_vps"] = bf16["best"]
-            extra["clip_bf16_median_vps"] = bf16["median"]
-            extra["clip_bf16_passes"] = bf16["passes"]
-
-    # everything past the headline runs subprocess-isolated (_spawn_sub's
-    # rationale above), ordered safest-first so an early helper crash
-    # costs the fewest parts. Probe overhead per sub is ~seconds; compiles
-    # hit the persistent XLA cache.
-    sub_timeout = float(os.environ.get("BENCH_SUB_TIMEOUT", "1200"))
-    extra.update(bench_host_pipeline())  # pure host CPU, no device risk
-    extra.update(_spawn_sub("clip_device_only", sub_timeout))
-    extra.update(_spawn_sub("pallas_corr", sub_timeout))
-    if os.environ.get("BENCH_SKIP_I3D") != "1":
-        extra.update(_spawn_sub("i3d_e2e", sub_timeout))
-        extra.update(_spawn_sub("i3d_agg", sub_timeout))
-        extra.update(_spawn_sub("i3d_device_only", sub_timeout))
-    if os.environ.get("BENCH_FLASH") == "1":
-        # opt-in even in isolation: the L=4096 flash Mosaic compile has
-        # crashed the helper before — a crash here would still kill the
-        # RELAY for any later run, not just this child
-        extra.update(_spawn_sub("flash_attention", sub_timeout))
-
     clip_base = baselines.get("clip_torch_cpu_vps")
     i3d_base = baselines.get("i3d_raft_torch_cpu_vps")
+
+    extra = {}
+    state = {
+        "metric": "videos/sec/chip (CLIP-ViT-B/32, uni_12, end-to-end)",
+        "value": None,
+        "unit": "videos/s",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+
+    def emit():
+        # a complete-so-far artifact line after EVERY part: the last
+        # parseable stdout line is always the fullest capture, so a
+        # helper/parent death mid-run can never again zero the artifact
+        # (r04 lost its measured CLIP numbers exactly that way)
+        print(json.dumps(state), flush=True)
+
     if clip_base:
         extra["clip_torch_cpu_vps"] = clip_base
-    if i3d_base and "i3d_raft_vps" in extra:
-        extra["i3d_raft_torch_cpu_vps"] = i3d_base
-        extra["i3d_raft_vs_torch_cpu"] = round(extra["i3d_raft_vps"] / i3d_base, 3)
     extra["baseline_provenance"] = (
         "reference torch code on this host's CPU (scripts/measure_baseline.py; "
         "BASELINE.md 'Measured baselines')"
     )
-    # reproducibility: the knobs this run actually measured with (derived
-    # from the run's own variables, not restated literals)
+    # reproducibility: the knobs this run actually measured with
+    group = _clip_group(n_videos)
     extra["bench_config"] = {
         "n_videos": n_videos,
         "clip_video_batch": group,
@@ -758,17 +830,90 @@ def main() -> None:
         # groups; the unaggregated comparison ships in clip_solo_*.
         "clip_agg_workload": "same-shape best case (N copies of one video)",
     }
-    print(
-        json.dumps(
-            {
-                "metric": "videos/sec/chip (CLIP-ViT-B/32, uni_12, end-to-end)",
-                "value": round(clip_vps, 3),
-                "unit": "videos/s",
-                "vs_baseline": round(clip_vps / clip_base, 3) if clip_base else None,
-                "extra": extra,
-            }
+
+    # pure-host part FIRST, before any device probe: even a tunnel-dead
+    # round carries measured numbers in its artifact (r02-r04 carried none)
+    extra.update(bench_host_pipeline())
+    emit()
+
+    if not _probe_backend(fatal=False):
+        extra["fatal"] = (
+            "jax backend unreachable (dead axon compile helper/tunnel?) — "
+            "host_pipeline keys above are real; no device numbers exist. "
+            "See BASELINE.md outage notes; re-run on a healthy host."
         )
-    )
+        emit()
+        return  # rc 0: the outage is recorded in-band in the artifact
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    sub_timeout = float(os.environ.get("BENCH_SUB_TIMEOUT", "1200"))
+
+    def part(name: str) -> dict:
+        r = _spawn_sub(name, sub_timeout)
+        extra.update(r)
+        emit()
+        return r
+
+    # headline (child-isolated like everything else, r5)
+    clip = part("clip_e2e")
+    if "clip_vps" in clip:
+        state["value"] = clip["clip_vps"]
+        if clip_base:
+            state["vs_baseline"] = round(clip["clip_vps"] / clip_base, 3)
+        emit()
+    # bf16 e2e variant: default-on since r5 (VERDICT r4 next #1 wants it
+    # in the DRIVER artifact, which runs plain `python bench.py`); the
+    # second XLA compile hits the persistent cache on re-runs
+    if os.environ.get("BENCH_BF16") != "0":
+        part("clip_bf16")
+    part("clip_device_only")
+    part("pallas_corr")
+
+    if os.environ.get("BENCH_SKIP_I3D") != "1":
+        # On TPU the i3d parts default to the decomposed conv3d lowering:
+        # the direct XLA 3D conv killed the compile helper (and with it
+        # the relay + every subsequent part) in rounds 2-4 — see
+        # models/common/layers.py::Conv3DCompat and
+        # scripts/repro_i3d_conv3d.py. An explicit VFT_CONV3D_IMPL wins.
+        if on_tpu and "VFT_CONV3D_IMPL" not in os.environ:
+            os.environ["VFT_CONV3D_IMPL"] = "decomposed"
+        probe = part("i3d_compile_probe")
+        if any(k.endswith("_error") for k in probe):
+            extra["i3d_skipped"] = (
+                "compile probe failed — i3d parts skipped to protect the relay"
+            )
+            emit()
+        else:
+            i3d = part("i3d_e2e")
+            if i3d_base and "i3d_raft_vps" in i3d:
+                extra["i3d_raft_torch_cpu_vps"] = i3d_base
+                extra["i3d_raft_vs_torch_cpu"] = round(
+                    i3d["i3d_raft_vps"] / i3d_base, 3
+                )
+                emit()
+            part("i3d_agg")
+            part("i3d_device_only")
+
+    if os.environ.get("BENCH_FLASH") == "1":
+        # opt-in even in isolation: the L=4096 flash Mosaic compile has
+        # crashed the helper before — a crash here would still kill the
+        # RELAY for any later run, not just this child
+        part("flash_attention")
+
+    # diagnostic, the VERY LAST device-touching action and OPT-IN like
+    # flash (same rationale: the direct 3D-conv compile killed the relay
+    # — not just the child — in r2-r4, so even after all numbers persist
+    # a crash would burn the rest of the window for follow-up chip work).
+    # scripts/on_tunnel_up.sh owns this experiment via the repro ladder;
+    # set BENCH_DIRECT_PROBE=1 to run it from the bench instead.
+    if (
+        on_tpu
+        and os.environ.get("VFT_CONV3D_IMPL") == "decomposed"
+        and os.environ.get("BENCH_DIRECT_PROBE") == "1"
+    ):
+        part("conv3d_direct_probe")
 
 
 if __name__ == "__main__":

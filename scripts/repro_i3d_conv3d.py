@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Minimal repro + bisect for the I3D 3D-conv TPU compile crash.
+
+Three rounds running, the axon compile helper died with ``UNAVAILABLE:
+TPU backend setup/compile error`` at the I3D warmup (BASELINE.md
+round-4 chip log) and took the relay down with it — losing every
+not-yet-persisted bench number. This script answers VERDICT r4 next #2:
+WHICH part of the I3D graph kills the compiler, and does the
+sum-of-2D-convs lowering (``VFT_CONV3D_IMPL=decomposed``,
+models/common/layers.py::Conv3DCompat) dodge it?
+
+Every case runs in a CHILD process ordered safest-first, so the first
+crash is recorded instead of killing the bisect; after each case the
+parent re-checks the relay listener and stops early (recording the
+outage) if the helper died. Run on a healthy window via
+scripts/on_tunnel_up.sh; output is tee'd to I3D_CONV3D_REPRO.txt.
+
+Case ladder (each is the smallest graph adding one suspect):
+  conv_tiny_direct     one 3x3x3 lax conv, 8x56x56        — baseline 3D lowering
+  conv_stem_direct     7x7x7 stride-2 asymmetric-pad conv — the I3D stem
+  pool_ceil            max_pool_tf (-inf fill, ceil pads) — the pool suspect
+  avgpool_277          the (2,7,7) VALID avg pool head
+  stem_block_direct    Unit3D stem + pool + 1x1 + 3x3     — composite
+  full_i3d_decomposed  whole net, decomposed convs        — the workaround
+  full_i3d_direct      whole net, direct convs            — the known crasher
+Order within the ladder is least→most risky; the known-fatal full
+direct graph goes LAST so the workaround verdict is always captured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# children are invoked as `python scripts/repro_i3d_conv3d.py --case X`,
+# which puts scripts/ (not the repo root) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CASES = [
+    "conv_tiny_direct",
+    "conv_stem_direct",
+    "pool_ceil",
+    "avgpool_277",
+    "stem_block_direct",
+    "full_i3d_decomposed",
+    "full_i3d_direct",
+]
+
+# tiny-but-representative shapes: small T/H/W so a PASS compiles in
+# seconds, but real kernels/strides/padding so the lowering is the one
+# the north-star config uses
+STEM_IN = (1, 17, 112, 112, 3)
+FULL_IN = (1, 17, 224, 224, 3)
+
+
+def _run_case(name: str) -> None:
+    """Child entry: build + jit + execute one case, print PASS line."""
+    from video_features_tpu.parallel.devices import pin_platform
+
+    pin_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.perf_counter()
+    rng = np.random.RandomState(0)
+
+    if name.startswith("full_i3d"):
+        os.environ["VFT_CONV3D_IMPL"] = (
+            "decomposed" if name.endswith("decomposed") else "direct"
+        )
+        from video_features_tpu.models.i3d.model import build, init_params
+
+        model = build()
+        params = jax.device_put(init_params("rgb"))
+        x = jnp.asarray(rng.randn(*FULL_IN).astype(np.float32))
+        feats, logits = jax.jit(
+            lambda p, x: model.apply({"params": p}, x)
+        )(params, x)
+        out = float(jnp.sum(feats)) + float(jnp.sum(logits))
+    elif name == "conv_tiny_direct":
+        x = jnp.asarray(rng.randn(1, 8, 56, 56, 32).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 3, 3, 32, 64).astype(np.float32) * 0.01)
+        out = float(
+            jnp.sum(
+                jax.jit(
+                    lambda x, w: jax.lax.conv_general_dilated(
+                        x, w, (1, 1, 1), [(1, 1)] * 3,
+                        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                    )
+                )(x, w)
+            )
+        )
+    elif name == "conv_stem_direct":
+        from video_features_tpu.models.i3d.model import tf_same_pads
+
+        x = jnp.asarray(rng.randn(*STEM_IN).astype(np.float32))
+        w = jnp.asarray(rng.randn(7, 7, 7, 3, 64).astype(np.float32) * 0.01)
+        pads = tf_same_pads((7, 7, 7), (2, 2, 2))
+        out = float(
+            jnp.sum(
+                jax.jit(
+                    lambda x, w: jax.lax.conv_general_dilated(
+                        x, w, (2, 2, 2), pads,
+                        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                    )
+                )(x, w)
+            )
+        )
+    elif name == "pool_ceil":
+        from video_features_tpu.models.i3d.model import max_pool_tf
+
+        x = jnp.asarray(np.abs(rng.randn(1, 9, 56, 56, 64)).astype(np.float32))
+        out = float(jnp.sum(jax.jit(
+            lambda x: max_pool_tf(x, (3, 3, 3), (2, 2, 2))
+        )(x)))
+    elif name == "avgpool_277":
+        from flax import linen as nn
+
+        x = jnp.asarray(rng.randn(1, 3, 7, 7, 128).astype(np.float32))
+        out = float(jnp.sum(jax.jit(
+            lambda x: nn.avg_pool(x, (2, 7, 7), strides=(1, 1, 1))
+        )(x)))
+    elif name == "stem_block_direct":
+        os.environ["VFT_CONV3D_IMPL"] = "direct"
+        import flax.linen as nn
+
+        from video_features_tpu.models.i3d.model import Unit3D, max_pool_tf
+
+        class Stem(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = Unit3D(64, (7, 7, 7), (2, 2, 2), name="conv3d_1a_7x7")(x)
+                x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
+                x = Unit3D(64, name="conv3d_2b_1x1")(x)
+                x = Unit3D(192, (3, 3, 3), name="conv3d_2c_3x3")(x)
+                return x
+
+        model = Stem()
+        x = jnp.asarray(rng.randn(*STEM_IN).astype(np.float32))
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        out = float(jnp.sum(jax.jit(
+            lambda p, x: model.apply({"params": p}, x)
+        )(params, x)))
+    else:
+        raise SystemExit(f"unknown case {name}")
+
+    print(
+        f"CASE_RESULT {json.dumps({'case': name, 'status': 'PASS', 'sum': out, 'seconds': round(time.perf_counter() - t0, 1), 'backend': jax.default_backend()})}"
+    )
+
+
+def _relay_up() -> bool:
+    out = subprocess.run(
+        ["ss", "-tln"], capture_output=True, text=True
+    ).stdout
+    import re
+
+    return bool(re.search(r"[:.]8083([^0-9]|$)", out))
+
+
+def main() -> int:
+    results = []
+    for case in CASES:
+        if os.environ.get("REPRO_IGNORE_RELAY") != "1" and not _relay_up():
+            results.append({"case": case, "status": "SKIP_RELAY_DOWN"})
+            print(f"{case}: SKIP — relay died earlier in the ladder")
+            continue
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--case", case],
+                capture_output=True, text=True,
+                timeout=float(os.environ.get("REPRO_CASE_TIMEOUT", "600")),
+            )
+        except subprocess.TimeoutExpired:
+            # a hung child (dead helper behind a live listener) must be a
+            # recorded verdict, not a parent-killing traceback — the
+            # ladder's whole point is that the first crash is data
+            results.append({"case": case, "status": "TIMEOUT"})
+            print(f"{case}: TIMEOUT — child hung (dead compile helper?)")
+            continue
+        rec = None
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            if line.startswith("CASE_RESULT "):
+                rec = json.loads(line[len("CASE_RESULT "):])
+                break
+        if rec is None:
+            tail = (proc.stderr or "").strip().splitlines()[-4:]
+            rec = {
+                "case": case,
+                "status": f"CRASH rc={proc.returncode}",
+                "stderr_tail": " | ".join(tail),
+            }
+        results.append(rec)
+        print(json.dumps(rec))
+    print("=== VERDICT TABLE ===")
+    for r in results:
+        print(f"{r['case']:22s} {r['status']}")
+    full = {r["case"]: r["status"] for r in results}
+    ok = any(
+        full.get(c) == "PASS"
+        for c in ("full_i3d_decomposed", "full_i3d_direct")
+    )
+    if full.get("full_i3d_direct") != "PASS" and full.get("full_i3d_decomposed") == "PASS":
+        print("RECOMMENDATION: set VFT_CONV3D_IMPL=decomposed on this backend")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--case":
+        _run_case(sys.argv[2])
+        sys.exit(0)
+    sys.exit(main())

@@ -1,35 +1,40 @@
 #!/usr/bin/env bash
-# Round-4 relay watcher. Rounds 2 and 3 both lost their bench windows to
-# the dead 127.0.0.1:8083 axon compile helper; this round we poll from
-# minute zero. Every probe is timestamped into PROBE_LOG so a third
-# outage round is auditable (VERDICT r3 "What's weak" #1), and the
-# moment the relay listens we run the staged capture runbook
-# (scripts/on_tunnel_up.sh) exactly once.
+# Round-5 relay watcher. Rounds 2-4 all lost their bench windows to the
+# dead 127.0.0.1:8083 axon compile helper; we poll from minute zero.
+# Every probe is timestamped into PROBE_LOG so an outage round is
+# auditable (VERDICT r4 "What's weak" #1), and the moment the relay
+# listens we run the staged capture runbook (scripts/on_tunnel_up.sh).
 #
-# Usage: nohup setsid bash scripts/tunnel_watch.sh > /tmp/tunnel_watch.log 2>&1 &
+# Usage: nohup setsid bash scripts/tunnel_watch.sh > /tmp/tunnel_watch_r05.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-PROBE_LOG=${PROBE_LOG:-/tmp/probe_log_r04.txt}
+PROBE_LOG=${PROBE_LOG:-/tmp/probe_log_r05.txt}
 INTERVAL=${INTERVAL:-60}
-DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-12} * 3600 ))
+CAPTURED=0
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if ss -tln | grep -qE '[:.]8083([^0-9]|$)'; then
-    echo "$(date -u +%FT%TZ) UP — relay listening, starting capture" >> "$PROBE_LOG"
-    # append, never truncate: each attempt's failure output is the audit
-    # trail VERDICT r3 asked for — a later attempt must not wipe it
-    echo "=== capture attempt $(date -u +%FT%TZ) ===" >> /tmp/on_tunnel_up_r04.log
-    bash scripts/on_tunnel_up.sh >> /tmp/on_tunnel_up_r04.log 2>&1
-    rc=$?
-    echo "$(date -u +%FT%TZ) capture finished rc=$rc" >> "$PROBE_LOG"
-    if [ $rc -eq 0 ]; then
-      exit 0
+    if [ "$CAPTURED" -eq 1 ]; then
+      echo "$(date -u +%FT%TZ) up (already captured)" >> "$PROBE_LOG"
+    else
+      echo "$(date -u +%FT%TZ) UP — relay listening, starting capture" >> "$PROBE_LOG"
+      # append, never truncate: each attempt's failure output is the audit
+      # trail VERDICT r3/r4 asked for — a later attempt must not wipe it
+      echo "=== capture attempt $(date -u +%FT%TZ) ===" >> /tmp/on_tunnel_up_r05.log
+      bash scripts/on_tunnel_up.sh >> /tmp/on_tunnel_up_r05.log 2>&1
+      rc=$?
+      echo "$(date -u +%FT%TZ) capture finished rc=$rc" >> "$PROBE_LOG"
+      if [ $rc -eq 0 ]; then
+        CAPTURED=1
+      fi
+      # on failure (relay flapped?) keep polling for another window; on
+      # success keep logging liveness so the window's extent is auditable
     fi
-    # capture failed (relay flapped?) — keep polling for another window
   else
     echo "$(date -u +%FT%TZ) down" >> "$PROBE_LOG"
   fi
   sleep "$INTERVAL"
 done
-echo "$(date -u +%FT%TZ) watcher deadline reached without a successful capture" >> "$PROBE_LOG"
-exit 1
+echo "$(date -u +%FT%TZ) watcher deadline reached (captured=$CAPTURED)" >> "$PROBE_LOG"
+[ "$CAPTURED" -eq 1 ]

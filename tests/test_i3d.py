@@ -355,3 +355,76 @@ def test_i3d_over_cap_video_defers_decode(sample_video, monkeypatch):
     out = ex2(range(2))
     for s, p in zip(ref, out):
         np.testing.assert_array_equal(s["rgb"], p["rgb"])
+
+
+def test_conv3d_decomposed_matches_direct(monkeypatch):
+    """Conv3DCompat's sum-of-2D-convs lowering (the TPU 3D-conv-crash
+    workaround, VFT_CONV3D_IMPL=decomposed) is numerically identical to
+    the direct lowering on the same params — including strided time,
+    asymmetric TF-SAME pads, and bias."""
+    import jax
+
+    from video_features_tpu.models.common.layers import Conv3DCompat
+    from video_features_tpu.models.i3d.model import tf_same_pads
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 9, 20, 20, 4).astype(np.float32))
+    for kernel, stride, bias in [
+        ((7, 7, 7), (2, 2, 2), False),  # the I3D stem shape
+        ((3, 3, 3), (1, 1, 1), False),
+        ((1, 1, 1), (1, 1, 1), True),
+        ((2, 3, 3), (2, 1, 1), True),  # even kt + strided time
+    ]:
+        m = Conv3DCompat(8, kernel, stride, tf_same_pads(kernel, stride),
+                         use_bias=bias)
+        params = m.init(jax.random.PRNGKey(0), x)
+        monkeypatch.setenv("VFT_CONV3D_IMPL", "direct")
+        direct = m.apply(params, x)
+        monkeypatch.setenv("VFT_CONV3D_IMPL", "decomposed")
+        decomp = m.apply(params, x)
+        assert direct.shape == decomp.shape
+        np.testing.assert_allclose(
+            np.asarray(direct), np.asarray(decomp), atol=2e-5,
+            err_msg=f"kernel={kernel} stride={stride}",
+        )
+
+
+def test_conv3d_impl_env_validation(monkeypatch):
+    from video_features_tpu.models.common.layers import conv3d_impl
+
+    monkeypatch.setenv("VFT_CONV3D_IMPL", "bogus")
+    with pytest.raises(ValueError, match="direct|decomposed"):
+        conv3d_impl()
+
+
+def test_extract_i3d_conv3d_impl_flag(monkeypatch, sample_video):
+    """--conv3d_impl threads into THIS extractor's model (never the
+    process env — r5 review: two extractors with different configs in
+    one process must not clobber each other); 'auto' defers to the
+    VFT_CONV3D_IMPL env var at trace time."""
+    import os
+
+    from video_features_tpu.models.common.layers import conv3d_impl
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def make(impl):
+        return ExtractI3D(
+            ExtractionConfig(
+                allow_random_init=True,
+                feature_type="i3d",
+                video_paths=[sample_video],
+                conv3d_impl=impl,
+            ),
+            external_call=True,
+        )
+
+    env_before = os.environ.get("VFT_CONV3D_IMPL")
+    a = make("decomposed")
+    b = make("direct")
+    c = make("auto")
+    assert a.conv_impl == "decomposed"
+    assert b.conv_impl == "direct"  # a's choice did not leak into b
+    assert c.conv_impl is None  # auto -> env decides at trace time
+    assert os.environ.get("VFT_CONV3D_IMPL") == env_before  # no env writes
+    monkeypatch.setenv("VFT_CONV3D_IMPL", "decomposed")
+    assert conv3d_impl() == "decomposed"  # what c's model would trace with

@@ -165,6 +165,16 @@ class ExtractionConfig:
     # instead of sharding the frame batch. The long-sequence regime:
     # activation memory per chip is O(L/n). CLIP only (the transformer).
     mesh_context: bool = False
+    # 3D-conv lowering for the I3D family (common/layers.py::Conv3DCompat):
+    #   'auto'       — honor the VFT_CONV3D_IMPL env var, else direct;
+    #   'direct'     — XLA's native 3D convolution (fastest when it works);
+    #   'decomposed' — sum of kt 2D convs over strided time slices, byte-
+    #                  compatible checkpoints, identical math. The escape
+    #                  hatch for TPU stacks whose 3D-conv compile crashes
+    #                  (BASELINE.md round-4 chip log; bench.py defaults the
+    #                  i3d parts to 'decomposed' on TPU for this reason).
+    # Explicit direct/decomposed overrides the env var either way.
+    conv3d_impl: str = "auto"
 
     def __post_init__(self) -> None:
         if self.streams is not None and not isinstance(self.streams, (list, tuple)):
@@ -229,6 +239,8 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         )
     if cfg.attn not in ("fused", "flash", "blockwise"):
         raise ValueError(f"unknown attn core: {cfg.attn}")
+    if cfg.conv3d_impl not in ("auto", "direct", "decomposed"):
+        raise ValueError(f"unknown conv3d_impl: {cfg.conv3d_impl}")
     if cfg.mesh_context and cfg.attn != "fused":
         raise ValueError(
             "--mesh_context injects the ring-attention core; it cannot "
@@ -301,6 +313,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="attention core for the CLIP family: fused "
                         "full-score (default, best at ViT lengths), the "
                         "Pallas flash kernel, or the XLA blockwise core")
+    p.add_argument("--conv3d_impl", default="auto",
+                   choices=["auto", "direct", "decomposed"],
+                   help="I3D 3D-conv lowering: XLA's native 3D conv, or "
+                        "the checkpoint-identical sum-of-2D-convs "
+                        "decomposition (the workaround for TPU stacks "
+                        "whose 3D-conv compile crashes); auto honors "
+                        "VFT_CONV3D_IMPL, else direct")
     p.add_argument("--video_batch", type=int, default=1,
                    help="aggregate up to N videos' prepared batches into "
                         "one device dispatch (CLIP/ResNet/R21D); 1 = off")

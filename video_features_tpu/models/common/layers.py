@@ -2,6 +2,9 @@
 
 from __future__ import annotations
 
+import os
+from typing import Sequence, Tuple
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -28,3 +31,93 @@ class EvalBatchNorm(nn.Module):
         # fp32 params; promotion does the rest); activations keep their
         # incoming dtype so the bf16 stream isn't silently widened
         return (x.astype(jnp.float32) * inv + (bias - mean * inv)).astype(x.dtype)
+
+
+def conv3d_impl() -> str:
+    """Which lowering Conv3DCompat uses: ``direct`` (one
+    ``lax.conv_general_dilated`` over DHW — XLA's native 3D conv) or
+    ``decomposed`` (a sum of kt 2D convs over strided time slices —
+    mathematically identical, avoids the TPU 3D-conv lowering that has
+    crashed the axon compile helper, BASELINE.md round-4 chip log).
+
+    Env knob ``VFT_CONV3D_IMPL`` so the bench's compile-probe child can
+    select the safe path for subsequent subprocesses without config
+    plumbing; the CLI exposes it as ``--conv3d_impl``.
+    """
+    impl = os.environ.get("VFT_CONV3D_IMPL", "direct")
+    if impl not in ("direct", "decomposed"):
+        raise ValueError(f"VFT_CONV3D_IMPL must be direct|decomposed, got {impl!r}")
+    return impl
+
+
+class Conv3DCompat(nn.Module):
+    """3D conv with a checkpoint-identical choice of TPU lowering.
+
+    Parameter names/shapes match ``nn.Conv`` exactly (``kernel``
+    (kt, kh, kw, Cin, Cout) + optional ``bias``), so converted reference
+    checkpoints load identically under either impl (ref
+    i3d_net.py:37-105 is a plain torch Conv3d; the decomposition is our
+    TPU-side workaround, not a semantic change).
+
+    ``decomposed``: conv3d(x, w) == sum_i conv2d(x[:, i::st], w[i]) after
+    explicit time padding — kt <= 7 everywhere in I3D, so the unrolled
+    sum stays a handful of MXU-friendly 2D convs.
+    """
+
+    features: int
+    kernel: Tuple[int, int, int]
+    stride: Tuple[int, int, int]
+    padding: Sequence[Tuple[int, int]]  # (lo, hi) per (t, h, w)
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+    # None: read VFT_CONV3D_IMPL at trace time (process-wide default);
+    # 'direct'/'decomposed': this model's explicit choice — threaded from
+    # --conv3d_impl so one extractor's config never leaks into another's
+    impl: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kt, kh, kw = self.kernel
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (kt, kh, kw, x.shape[-1], self.features),
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros, (self.features,))
+            if self.use_bias
+            else None
+        )
+        w = w.astype(self.dtype)
+        x = x.astype(self.dtype)
+        if (self.impl or conv3d_impl()) == "direct":
+            out = jax.lax.conv_general_dilated(
+                x,
+                w,
+                window_strides=self.stride,
+                padding=list(self.padding),
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            )
+        else:
+            st = self.stride[0]
+            lo, hi = self.padding[0]
+            xp = jnp.pad(x, ((0, 0), (lo, hi), (0, 0), (0, 0), (0, 0)))
+            t_out = (xp.shape[1] - kt) // st + 1
+            out = None
+            for i in range(kt):
+                xi = jax.lax.slice_in_dim(
+                    xp, i, i + (t_out - 1) * st + 1, stride=st, axis=1
+                )
+                B = xi.shape[0]
+                oi = jax.lax.conv_general_dilated(
+                    xi.reshape((B * t_out,) + xi.shape[2:]),
+                    w[i],
+                    window_strides=self.stride[1:],
+                    padding=list(self.padding[1:]),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                )
+                out = oi if out is None else out + oi
+            out = out.reshape((B, t_out) + out.shape[1:])
+        if b is not None:
+            out = out + b.astype(self.dtype)
+        return out

@@ -125,6 +125,14 @@ class ExtractI3D(BaseExtractor):
         # surplus outputs are sliced off. Mesh runs pin B=1 — there the stack's
         # FRAME axis is what shards (sequence parallelism).
         self.stack_batch = max(int(self.config.batch_size or 1), 1)
+        # --conv3d_impl: an explicit direct/decomposed choice is threaded
+        # into THIS extractor's model (Conv3DCompat.impl) — never written
+        # to the process env, so two extractors with different configs in
+        # one process can't clobber each other's lowering. 'auto' (None)
+        # defers to the VFT_CONV3D_IMPL env var at trace time, which is
+        # how bench.py selects the safe lowering process-wide on TPU.
+        impl = getattr(self.config, "conv3d_impl", "auto")
+        self.conv_impl = None if impl in (None, "auto") else impl
         self._host_params: Dict[str, object] = {}
 
     def feature_keys(self):
@@ -225,7 +233,9 @@ class ExtractI3D(BaseExtractor):
         key = tuple(shape)
         if key in state["fns"]:
             return state["fns"][key]
-        i3d = i3d_build(dtype=state.get("dtype", jnp.float32))
+        i3d = i3d_build(
+            dtype=state.get("dtype", jnp.float32), conv_impl=self.conv_impl
+        )
         fns = {}
 
         if is_mesh(state["device"]):

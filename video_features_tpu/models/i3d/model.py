@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from video_features_tpu.models.common.layers import EvalBatchNorm
+from video_features_tpu.models.common.layers import Conv3DCompat, EvalBatchNorm
 
 I3D_FEATURE_DIM = 1024
 I3D_NUM_CLASSES = 400
@@ -50,16 +50,23 @@ class Unit3D(nn.Module):
     use_bias: bool = False
     activation: bool = True
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str | None = None  # None = VFT_CONV3D_IMPL env, else direct
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Conv(
+        # Conv3DCompat: param-tree-identical to nn.Conv, but the lowering
+        # is selectable (--conv3d_impl / VFT_CONV3D_IMPL) — the direct
+        # XLA 3D conv crashed the TPU compile helper three rounds running
+        # (BASELINE.md round-4 chip log), so a decomposed sum-of-2D-convs
+        # escape hatch is load-bearing for the north-star config
+        x = Conv3DCompat(
             self.features,
             self.kernel,
-            strides=self.stride,
-            padding=tf_same_pads(self.kernel, self.stride),
+            self.stride,
+            tf_same_pads(self.kernel, self.stride),
             use_bias=self.use_bias,
             dtype=self.dtype,
+            impl=self.conv_impl,
             name="conv3d",
         )(x)
         if self.use_bn:
@@ -89,11 +96,14 @@ class Mixed(nn.Module):
 
     out: Sequence[int]
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         o = self.out
-        u = lambda *a, **kw: Unit3D(*a, dtype=self.dtype, **kw)
+        u = lambda *a, **kw: Unit3D(
+            *a, dtype=self.dtype, conv_impl=self.conv_impl, **kw
+        )
         b0 = u(o[0], name="branch_0")(x)
         b1 = u(o[2], (3, 3, 3), name="branch_1_1")(u(o[1], name="branch_1_0")(x))
         b2 = u(o[4], (3, 3, 3), name="branch_2_1")(u(o[3], name="branch_2_0")(x))
@@ -107,26 +117,30 @@ class I3D(nn.Module):
 
     num_classes: int = I3D_NUM_CLASSES
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        ci = self.conv_impl
+        u = lambda *a, **kw: Unit3D(*a, dtype=self.dtype, conv_impl=ci, **kw)
+        m = lambda out, name: Mixed(out, self.dtype, ci, name=name)
         x = x.astype(self.dtype)
-        x = Unit3D(64, (7, 7, 7), (2, 2, 2), dtype=self.dtype, name="conv3d_1a_7x7")(x)
+        x = u(64, (7, 7, 7), (2, 2, 2), name="conv3d_1a_7x7")(x)
         x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
-        x = Unit3D(64, dtype=self.dtype, name="conv3d_2b_1x1")(x)
-        x = Unit3D(192, (3, 3, 3), dtype=self.dtype, name="conv3d_2c_3x3")(x)
+        x = u(64, name="conv3d_2b_1x1")(x)
+        x = u(192, (3, 3, 3), name="conv3d_2c_3x3")(x)
         x = max_pool_tf(x, (1, 3, 3), (1, 2, 2))
-        x = Mixed([64, 96, 128, 16, 32, 32], self.dtype, name="mixed_3b")(x)
-        x = Mixed([128, 128, 192, 32, 96, 64], self.dtype, name="mixed_3c")(x)
+        x = m([64, 96, 128, 16, 32, 32], "mixed_3b")(x)
+        x = m([128, 128, 192, 32, 96, 64], "mixed_3c")(x)
         x = max_pool_tf(x, (3, 3, 3), (2, 2, 2))
-        x = Mixed([192, 96, 208, 16, 48, 64], self.dtype, name="mixed_4b")(x)
-        x = Mixed([160, 112, 224, 24, 64, 64], self.dtype, name="mixed_4c")(x)
-        x = Mixed([128, 128, 256, 24, 64, 64], self.dtype, name="mixed_4d")(x)
-        x = Mixed([112, 144, 288, 32, 64, 64], self.dtype, name="mixed_4e")(x)
-        x = Mixed([256, 160, 320, 32, 128, 128], self.dtype, name="mixed_4f")(x)
+        x = m([192, 96, 208, 16, 48, 64], "mixed_4b")(x)
+        x = m([160, 112, 224, 24, 64, 64], "mixed_4c")(x)
+        x = m([128, 128, 256, 24, 64, 64], "mixed_4d")(x)
+        x = m([112, 144, 288, 32, 64, 64], "mixed_4e")(x)
+        x = m([256, 160, 320, 32, 128, 128], "mixed_4f")(x)
         x = max_pool_tf(x, (2, 2, 2), (2, 2, 2))
-        x = Mixed([256, 160, 320, 32, 128, 128], self.dtype, name="mixed_5b")(x)
-        x = Mixed([384, 192, 384, 48, 128, 128], self.dtype, name="mixed_5c")(x)
+        x = m([256, 160, 320, 32, 128, 128], "mixed_5b")(x)
+        x = m([384, 192, 384, 48, 128, 128], "mixed_5c")(x)
 
         # AvgPool3d((2, 7, 7), stride 1), VALID (ref i3d_net.py:227);
         # fp32 pooling + heads: features are the user-facing contract
@@ -138,14 +152,18 @@ class I3D(nn.Module):
             use_bn=False,
             use_bias=True,
             activation=False,
+            conv_impl=ci,
             name="conv3d_0c_1x1",
         )(x)
         logits = jnp.mean(logits, axis=(1, 2, 3))  # (B, num_classes)
         return feats, logits
 
 
-def build(num_classes: int = I3D_NUM_CLASSES, dtype=jnp.float32) -> I3D:
-    return I3D(num_classes=num_classes, dtype=dtype)
+def build(
+    num_classes: int = I3D_NUM_CLASSES, dtype=jnp.float32,
+    conv_impl: str | None = None,
+) -> I3D:
+    return I3D(num_classes=num_classes, dtype=dtype, conv_impl=conv_impl)
 
 
 def init_params(modality: str, seed: int = 0, num_classes: int = I3D_NUM_CLASSES):
