@@ -21,6 +21,7 @@ recipe instead of guessing.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import os
 import subprocess
 import sys
@@ -28,41 +29,54 @@ import urllib.request
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-# feature_type -> [(url, filename)]; converter feature_type defaults to
-# the key (i3d converts each stream file separately)
+# feature_type -> [(url, filename, sha256)]; converter feature_type
+# defaults to the key (i3d converts each stream file separately).
+# sha256: full 64-hex digest, a torch-hub-style hex PREFIX (matched
+# against the digest's head), or None when upstream publishes no hash
+# (verified-size-only, warned loudly — advisor r4: a truncated-but-
+# nonempty download must not sail into convert_weights).
 SOURCES = {
     "CLIP-ViT-B/32": [(
+        # the CLIP blob URLs embed their own sha256 path component
         "https://openaipublic.azureedge.net/clip/models/"
         "40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af/"
         "ViT-B-32.pt",
         "ViT-B-32.pt",
+        "40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af",
     )],
     "CLIP-ViT-B/16": [(
         "https://openaipublic.azureedge.net/clip/models/"
         "5806e77cd80f8b59890b7e101eabd078d9fb84e6937f9e85e4ecb61988df416f/"
         "ViT-B-16.pt",
         "ViT-B-16.pt",
+        "5806e77cd80f8b59890b7e101eabd078d9fb84e6937f9e85e4ecb61988df416f",
     )],
     "vggish_torch": [(
         "https://github.com/harritaylor/torchvggish/releases/download/"
         "v0.1/vggish-10086976.pth",
         "vggish-10086976.pth",
+        "10086976",  # torch-hub convention: filename carries the digest head
     )],
     "pwc": [(
-        "http://content.sniklaus.com/github/pytorch-pwc/"
+        # https first (advisor r4); upstream publishes no digest — record
+        # one locally after a trusted first download if you need pinning
+        "https://content.sniklaus.com/github/pytorch-pwc/"
         "network-default.pytorch",
         "network-default.pytorch",
+        None,
     )],
     "i3d": [
         (
             "https://github.com/hassony2/kinetics_i3d_pytorch/raw/master/"
             "model/model_rgb.pth",
             "model_rgb.pth",
+            None,  # upstream publishes no digest
         ),
         (
             "https://github.com/hassony2/kinetics_i3d_pytorch/raw/master/"
             "model/model_flow.pth",
             "model_flow.pth",
+            None,  # upstream publishes no digest
         ),
     ],
 }
@@ -77,11 +91,40 @@ MANUAL = {
 }
 
 
-def fetch(url: str, dest: str, opener=None) -> str:
-    """Download ``url`` to ``dest`` (skip if present); return the path."""
+def _verify_ok(path: str, sha256) -> bool:
+    """True if ``path`` matches the full digest / hex prefix (or, with no
+    published digest, is at least non-empty). On failure the file is
+    removed (so the caller can re-download) and the reason printed."""
+    if sha256 is None:
+        if os.path.getsize(path) > 0:
+            print(f"WARNING: no published sha256 for {os.path.basename(path)}"
+                  " — only checked the download is non-empty")
+            return True
+        os.remove(path)
+        print(f"empty download removed: {path}")
+        return False
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    got = digest.hexdigest()
+    if not got.startswith(sha256.lower()):
+        os.remove(path)
+        print(f"sha256 mismatch for {path}: got {got}, want {sha256}[...] — "
+              "tampered or truncated file removed")
+        return False
+    print(f"sha256 ok: {os.path.basename(path)} ({sha256[:16]}...)")
+    return True
+
+
+def fetch(url: str, dest: str, opener=None, sha256=None) -> str:
+    """Download ``url`` to ``dest`` (skip if present AND verified);
+    return the path."""
     if opener is None:  # resolved at call time so tests can monkeypatch
         opener = urllib.request.urlopen
-    if os.path.exists(dest) and os.path.getsize(dest) > 0:
+    if os.path.exists(dest) and os.path.getsize(dest) > 0 and _verify_ok(dest, sha256):
+        # a stale/truncated leftover fails _verify_ok, which removes it —
+        # falling through to a fresh download in THIS run
         print(f"already present: {dest}")
         return dest
     print(f"fetching {url}")
@@ -93,6 +136,11 @@ def fetch(url: str, dest: str, opener=None) -> str:
                 break
             f.write(chunk)
     os.replace(tmp, dest)  # atomic: no truncated file left behind on Ctrl-C
+    if not _verify_ok(dest, sha256):
+        raise SystemExit(
+            f"sha256 mismatch on freshly downloaded {dest} — "
+            "tampered upstream or corrupted transfer; not converting"
+        )
     return dest
 
 
@@ -110,8 +158,8 @@ def main(argv=None) -> int:
 
     os.makedirs(args.dest, exist_ok=True)
     rc = 0
-    for url, fname in SOURCES[args.feature_type]:
-        src = fetch(url, os.path.join(args.dest, fname))
+    for url, fname, sha in SOURCES[args.feature_type]:
+        src = fetch(url, os.path.join(args.dest, fname), sha256=sha)
         if args.skip_convert:
             continue
         dst = os.path.join(

@@ -428,3 +428,25 @@ def test_extract_i3d_conv3d_impl_flag(monkeypatch, sample_video):
     assert os.environ.get("VFT_CONV3D_IMPL") == env_before  # no env writes
     monkeypatch.setenv("VFT_CONV3D_IMPL", "decomposed")
     assert conv3d_impl() == "decomposed"  # what c's model would trace with
+
+
+def test_i3d_agg_key_declines_short_videos(sample_video):
+    """A video sampled to fewer than stack_size+1 frames yields zero
+    windows — agg_key must decline (advisor r4: an all-short group used
+    to IndexError in dispatch_group and ride solo_fallback's spurious
+    traceback to the right answer)."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    ex = ExtractI3D(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            video_paths=[sample_video],
+        ),
+        external_call=True,
+    )
+    frame = np.zeros((32, 32, 3), np.uint8)
+    short = (([frame] * 5, 25.0, [0.0] * 5), None, False, None)
+    assert ex.agg_key(short) is None
+    ok = (([frame] * (ex.stack_size + 1), 25.0, [0.0] * 65), None, False, None)
+    assert ex.agg_key(ok) is not None

@@ -291,3 +291,115 @@ def test_two_process_cluster_runs_extraction_job(tmp_path):
     # local outputs, process 0 has them all — must complete, not hang
     _spawn_cluster(script, video, out_dirs, tmp_path, env, resume="1")
     assert len(sorted(pathlib.Path(out_dirs[0]).rglob("*.npy"))) == 3
+
+
+_QUEUE_WORKER = r"""
+import os, sys
+port, proc_id, out_dir, tmp_dir = sys.argv[1:5]
+videos = sys.argv[5:]
+
+import jax
+
+# re-pin cpu before the axon plugin's discovery can dial the chip tunnel
+jax.config.update("jax_platforms", "cpu")
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=int(proc_id),
+)
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+from video_features_tpu.cli import main as cli_main
+
+# DEFAULT --sharding queue under jax.distributed: embarrassingly
+# parallel — this process must extract (and SINK) its own strided slice
+# of the video list on its own local devices, no collectives anywhere.
+# --device_ids 0 indexes the LOCAL device list (per-host contract).
+cli_main([
+    "--feature_type", "CLIP-ViT-B/32", "--extract_method", "uni_4",
+    "--device_ids", "0",
+    "--allow_random_init",
+    "--video_paths", *videos,
+    "--on_extraction", "save_numpy",
+    "--output_path", out_dir, "--tmp_path", tmp_dir,
+])
+print(f"proc {proc_id} extraction ok")
+"""
+
+
+def test_two_process_queue_mode_partitions_and_sinks_locally(tmp_path):
+    """Queue-mode (default) multi-process runs: advisor r4 found the
+    process-0-only sink gate silently dropped every other process's
+    outputs and the resume broadcast could deadlock. Now: each process
+    owns the strided slice of the video list, drives only its local
+    devices, and writes its own outputs — features identical to a
+    single-process run over the same list."""
+    import numpy as np
+
+    from video_features_tpu.utils.synth import synth_video
+
+    videos = [
+        synth_video(str(tmp_path / f"q{i}.mp4"), n_frames=8, width=96,
+                    height=64, seed=i)
+        for i in range(4)
+    ]
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_COORDINATOR_ADDRESS"}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["USE_TF"] = "0"
+    env["PYTHONPATH"] = (
+        _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    script = tmp_path / "queue_worker.py"
+    script.write_text(_QUEUE_WORKER)
+    out_dirs = [str(tmp_path / f"qout{i}") for i in range(2)]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(i), out_dirs[i],
+             str(tmp_path / f"qtmp{i}")] + videos,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"queue worker {i} failed:\n{out}"
+        assert f"proc {i} extraction ok" in out
+
+    # disjoint strided ownership: proc0 sank q0,q2; proc1 sank q1,q3
+    got0 = sorted(f.name for f in pathlib.Path(out_dirs[0]).rglob("*.npy"))
+    got1 = sorted(f.name for f in pathlib.Path(out_dirs[1]).rglob("*.npy"))
+    assert got0 == ["q0_CLIP-ViT-B-32.npy", "q2_CLIP-ViT-B-32.npy"], got0
+    assert got1 == ["q1_CLIP-ViT-B-32.npy", "q3_CLIP-ViT-B-32.npy"], got1
+
+    # features identical to a single-process run over the same list
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    ex = ExtractCLIP(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="CLIP-ViT-B/32",
+            extract_method="uni_4",
+            video_paths=videos,
+            cpu=True,
+        ),
+        external_call=True,
+    )
+    ref = ex(range(4))
+    for i, out_dir in ((0, out_dirs[0]), (2, out_dirs[0]),
+                       (1, out_dirs[1]), (3, out_dirs[1])):
+        (f,) = pathlib.Path(out_dir).rglob(f"q{i}_CLIP-ViT-B-32.npy")
+        np.testing.assert_array_equal(np.load(f), ref[i]["CLIP-ViT-B/32"])

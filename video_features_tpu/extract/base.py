@@ -77,16 +77,19 @@ class BaseExtractor:
             self.config.output_direct,
         )
         done = bool(files) and all(os.path.exists(f) for f in files)
-        # Multi-host: only process 0 writes (see _sink_or_collect), so a
-        # per-process local probe DIVERGES on per-host filesystems — and
-        # every sharded dispatch is collective, so one process skipping a
-        # video the others compute is a deadlock. All processes take
-        # process 0's answer; this broadcast is itself a collective, which
-        # is safe exactly because every process probes every video in the
-        # same order.
+        # Multi-host MESH runs: only process 0 writes (see
+        # _sink_or_collect), so a per-process local probe DIVERGES on
+        # per-host filesystems — and every sharded dispatch is collective,
+        # so one process skipping a video the others compute is a
+        # deadlock. All processes take process 0's answer; this broadcast
+        # is itself a collective, which is safe exactly because in mesh
+        # mode every process probes every video in the same order. Queue
+        # mode is the opposite: each process owns a DISJOINT video set in
+        # its own order, so a collective here would hang/mismatch — the
+        # local probe is the correct answer (advisor r4).
         from video_features_tpu.parallel.sharding import multihost
 
-        if multihost():
+        if multihost() and self.config.sharding == "mesh":
             from jax.experimental import multihost_utils
 
             done = bool(
@@ -160,15 +163,19 @@ class BaseExtractor:
         if self.external_call:
             results.append((order, feats_dict))
         else:
-            # multi-host mesh runs: every process executes the same loop
+            # multi-host MESH runs: every process executes the same loop
             # on the same path list (the sharded dispatches are collective
             # — all hosts must participate), but exactly ONE writes the
             # output files. Features are replicated at graph exit
             # (parallel/sharding.py::multihost), so process 0 holds the
-            # full arrays. Single-process runs: process_index() == 0.
+            # full arrays. Queue-mode multi-process runs are disjoint:
+            # every process computed different videos and must sink its
+            # own (advisor r4 — the old unconditional gate silently
+            # dropped non-zero processes' outputs). Single-process runs:
+            # process_index() == 0.
             import jax as _jax
 
-            if _jax.process_index() != 0:
+            if self.config.sharding == "mesh" and _jax.process_index() != 0:
                 return
             with self.timer.stage("sink"):
                 action_on_extraction(

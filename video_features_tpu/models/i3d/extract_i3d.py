@@ -644,6 +644,13 @@ class ExtractI3D(BaseExtractor):
         frames = decoded[0]
         if len(frames) > self.AGG_MAX_FRAMES:
             return None
+        # a video too short for even one stack_size+1 window yields zero
+        # slices — nothing to fuse; decline so the solo path handles it
+        # (mirrors flow_extract's empty-windows check; advisor r4: an
+        # all-short group used to IndexError in dispatch_group and ride
+        # the spurious solo_fallback traceback to the right answer)
+        if len(frames) < self.stack_size + 1:
+            return None
         return (
             frames[0].shape[:2],
             self.stack_size,

@@ -46,6 +46,14 @@ def resolve_devices(cfg=None, *, cpu: Optional[bool] = None,
     if cpu:
         return [jax.local_devices(backend="cpu")[0]]
     devices = list(jax.devices())
+    sharding = getattr(cfg, "sharding", "queue") if cfg is not None else "queue"
+    if sharding != "mesh" and jax.process_count() > 1:
+        # queue-mode multi-process runs are embarrassingly parallel: each
+        # process drives only its OWN chips (the reference's per-machine
+        # contract, ref main.py:43-48), so --device_ids index into this
+        # process's LOCAL devices. Mesh mode keeps the global view — its
+        # dispatches are collective across all processes.
+        devices = list(jax.local_devices())
     if device_ids:
         bad = [i for i in device_ids if i < 0 or i >= len(devices)]
         if bad:
