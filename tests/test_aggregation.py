@@ -190,6 +190,7 @@ def test_mixed_agg_paths_preserve_input_order(four_videos, tmp_path):
         np.testing.assert_allclose(f["resnet18"], s["resnet18"], atol=2e-4, rtol=1e-4)
 
 
+@pytest.mark.quick
 def test_video_batch_requires_decode_workers():
     from video_features_tpu.config import sanity_check
 

@@ -39,6 +39,7 @@ def _numpy_attention(q, k, v, kv_len=None):
     return np.einsum("nhqk,nhkd->nhqd", p, v)
 
 
+@pytest.mark.quick
 def test_fused_attention_matches_numpy():
     q, k, v = _qkv(np.random.default_rng(0))
     out = attention(q, k, v)
@@ -90,6 +91,7 @@ def test_fused_attention_kv_mask():
 
 
 @pytest.mark.parametrize("block", [4, 16, 64])
+@pytest.mark.quick
 def test_blockwise_matches_fused(block):
     q, k, v = _qkv(np.random.default_rng(2), lq=31, lk=57)
     ref = attention(q, k, v)

@@ -8,6 +8,7 @@ from video_features_tpu.io.sink import expected_output_files
 from video_features_tpu.utils.profiling import StageTimer, device_trace
 
 
+@pytest.mark.quick
 def test_expected_output_files_naming():
     files = expected_output_files(
         ["CLIP-ViT-B/32"], "/v/clip.mp4", "/o", "save_numpy", False
@@ -78,6 +79,7 @@ def test_error_isolation_continues(sample_video, tmp_path, capsys):
     assert saved == ["synth_resnet18.npy"]
 
 
+@pytest.mark.quick
 def test_stage_timer_accumulates():
     t = StageTimer()
     with t.stage("decode"):
@@ -100,6 +102,7 @@ def test_device_trace_writes_profile(tmp_path):
     assert files, "profiler trace directory is empty"
 
 
+@pytest.mark.quick
 def test_device_trace_noop_without_dir():
     with device_trace(None):
         pass

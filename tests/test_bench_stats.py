@@ -5,8 +5,10 @@ for medians + raw passes precisely so deltas can't be flattered)."""
 import numpy as np
 
 from bench import _pass_stats, _time_device_only
+import pytest
 
 
+@pytest.mark.quick
 def test_pass_stats_odd():
     s = _pass_stats(4, [2.0, 1.0, 4.0])  # 2, 4, 1 videos/s
     assert s["best"] == 4.0
@@ -14,6 +16,7 @@ def test_pass_stats_odd():
     assert s["passes"] == [1.0, 2.0, 4.0]  # sorted ascending
 
 
+@pytest.mark.quick
 def test_pass_stats_even():
     s = _pass_stats(6, [1.0, 2.0, 3.0, 6.0])  # 6, 3, 2, 1 videos/s
     assert s["best"] == 6.0
@@ -71,6 +74,7 @@ def test_device_only_bodies_gated_off_cpu(monkeypatch):
     assert bench_i3d_device_only() == {}
 
 
+@pytest.mark.quick
 def test_spawn_sub_isolates_child_failure():
     """_spawn_sub must survive a dead child and come back with a
     <name>_error string instead of raising — this is the containment that

@@ -29,6 +29,7 @@ def _rel(a, b):
     return np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
 
 
+@pytest.mark.quick
 def test_clip_bf16_drift_bounded():
     from video_features_tpu.models.clip.model import (
         CLIP_VIT_B32,

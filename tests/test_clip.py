@@ -68,6 +68,7 @@ def test_convert_auto_detects_hf():
     assert "resblock_0" in params
 
 
+@pytest.mark.quick
 def test_openai_converter_roundtrip():
     """Build an OpenAI-style state dict with the right shapes and check the
     converted tree matches the flax init tree exactly (structure+shapes)."""
@@ -184,6 +185,7 @@ def test_extract_clip_attn_flash_matches_fused(sample_video, tmp_path):
     np.testing.assert_allclose(blockwise, fused, atol=2e-5, rtol=1e-5)
 
 
+@pytest.mark.quick
 def test_mesh_context_rejects_attn_override():
     from video_features_tpu.config import sanity_check
 

@@ -52,6 +52,7 @@ def test_convert_cli_resnet_roundtrip(tmp_path, capsys):
     np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
 
 
+@pytest.mark.quick
 def test_convert_cli_rejects_checkpoint_suffix_dst(tmp_path):
     """Suffix inference refuses ambiguity: a non-.msgpack file-like dst
     needs an explicit --format (advisor r02: dotted dir names inferred

@@ -14,6 +14,9 @@ from video_features_tpu.io.sink import action_on_extraction
 from video_features_tpu.io.video import extract_frames, probe, read_all_frames, stream_frames
 from video_features_tpu.utils.labels import load_classes, show_predictions_on_dataset
 
+# whole-module smoke tier (README 'Quick test tier')
+pytestmark = pytest.mark.quick
+
 
 # --- config ---------------------------------------------------------------
 
@@ -272,3 +275,19 @@ def test_flow_quantize_boundary_no_uint8_wrap():
     q = flow_quantize_uint8_np(np.array([-25.0, -20.0, 0.0, 20.0, 25.0]))
     np.testing.assert_array_equal(q, [0, 0, 128, 255, 255])
     assert q.dtype == np.uint8
+
+
+def test_fps_retarget_validation():
+    from video_features_tpu.config import ExtractionConfig, sanity_check
+
+    base = dict(allow_random_init=True, video_paths=["x.mp4"])
+    with pytest.raises(ValueError, match="fps_retarget"):
+        sanity_check(ExtractionConfig(feature_type="resnet18",
+                                      fps_retarget="bogus", **base))
+    # reencode mirrors a reference path that only exists for
+    # resnet*/raft/pwc (ref utils/utils.py:222-244)
+    with pytest.raises(ValueError, match="reencode"):
+        sanity_check(ExtractionConfig(feature_type="i3d",
+                                      fps_retarget="reencode", **base))
+    sanity_check(ExtractionConfig(feature_type="pwc",
+                                  fps_retarget="reencode", **base))

@@ -8,6 +8,10 @@ import pathlib
 import re
 
 import yaml
+import pytest
+
+# whole-module smoke tier (README 'Quick test tier')
+pytestmark = pytest.mark.quick
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 DOCS = ROOT / "docs"

@@ -12,6 +12,10 @@ import pathlib
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1] / "scripts"))
 
 import fetch_weights as fw
+import pytest
+
+# whole-module smoke tier (README 'Quick test tier')
+pytestmark = pytest.mark.quick
 
 
 def test_url_registry_matches_reference_sources():

@@ -16,6 +16,7 @@ from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.models.i3d.convert import convert_state_dict
 
 
+@pytest.mark.quick
 def test_flow_transform_chain_matches_torch():
     """crop -> clamp[-20,20] -> uint8 quantize -> [-1,1]
     (ref i3d/transforms/transforms.py:21-51)."""
@@ -357,6 +358,7 @@ def test_i3d_over_cap_video_defers_decode(sample_video, monkeypatch):
         np.testing.assert_array_equal(s["rgb"], p["rgb"])
 
 
+@pytest.mark.quick
 def test_conv3d_decomposed_matches_direct(monkeypatch):
     """Conv3DCompat's sum-of-2D-convs lowering (the TPU 3D-conv-crash
     workaround, VFT_CONV3D_IMPL=decomposed) is numerically identical to
@@ -430,6 +432,7 @@ def test_extract_i3d_conv3d_impl_flag(monkeypatch, sample_video):
     assert conv3d_impl() == "decomposed"  # what c's model would trace with
 
 
+@pytest.mark.quick
 def test_i3d_agg_key_declines_short_videos(sample_video):
     """A video sampled to fewer than stack_size+1 frames yields zero
     windows — agg_key must decline (advisor r4: an all-short group used

@@ -22,6 +22,7 @@ def _frames(n=3, h=240, w=320, seed=0):
     )
 
 
+@pytest.mark.quick
 def test_matches_pil_chain_closely():
     frames = _frames()
     ref = np.stack([imagenet_preprocess(f) for f in frames])
@@ -48,6 +49,7 @@ def test_upscale_path():
     assert np.isfinite(out).all()
 
 
+@pytest.mark.quick
 def test_rejects_bad_shapes():
     with pytest.raises(ValueError):
         native.imagenet_preprocess_batch(np.zeros((2, 8, 8), np.uint8))

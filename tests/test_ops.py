@@ -20,6 +20,9 @@ from video_features_tpu.ops.preprocess import (
 from video_features_tpu.ops.resize import resize_bilinear
 from video_features_tpu.ops.sampler import bilinear_sampler, grid_sample
 
+# whole-module smoke tier (README 'Quick test tier')
+pytestmark = pytest.mark.quick
+
 RNG = np.random.RandomState(42)
 
 

@@ -26,6 +26,7 @@ from video_features_tpu.models.common.weights import (
     save_orbax,
 )
 from video_features_tpu.parallel.sharding import make_mesh
+import pytest
 
 SCRIPT = str(
     pathlib.Path(__file__).resolve().parents[1] / "scripts" / "convert_weights.py"
@@ -65,6 +66,7 @@ def test_convert_cli_orbax_roundtrip(tmp_path, capsys):
     )
 
 
+@pytest.mark.quick
 def test_load_orbax_sharded_restore_places_leaves(tmp_path):
     """Restore-with-mesh places every leaf under the requested specs
     (metadata-driven abstract target, no host tree)."""

@@ -32,6 +32,7 @@ def test_pallas_matches_xla(shape, tile_h):
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.quick
 def test_zero_padding_semantics():
     """Displacements that land outside f2 must contribute exact zeros
     (ref correlation.py zero-pads, no edge replication)."""

@@ -47,6 +47,7 @@ def test_flash_bf16_fp32_accumulation():
     )
 
 
+@pytest.mark.quick
 def test_flash_single_block():
     """Whole sequence in one (block_q, block_k): degenerate grid."""
     q, k, v = _qkv(np.random.default_rng(3), lq=16, lk=16)

@@ -22,10 +22,12 @@ from video_features_tpu.parallel.sharding import (
 )
 
 
+@pytest.mark.quick
 def test_eight_virtual_devices_present():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.quick
 def test_resolve_devices_ids_and_cpu():
     cfg = ExtractionConfig(device_ids=[0, 2], cpu=False)
     devs = resolve_devices(cfg)
@@ -69,6 +71,7 @@ def test_parallel_extraction_covers_all_videos(sample_video, tmp_path):
     assert all(s[1] == 512 and s[0] >= 4 for s in shapes)
 
 
+@pytest.mark.quick
 def test_make_mesh_shapes():
     mesh = make_mesh(jax.devices(), model=2)
     assert mesh.shape == {"data": 4, "model": 2}

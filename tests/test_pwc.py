@@ -14,6 +14,7 @@ from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.models.pwc.convert import convert_state_dict
 
 
+@pytest.mark.quick
 def test_converter_rejects_unconsumed():
     from test_reference_parity import _load_reference_pwc
 

@@ -114,6 +114,7 @@ def test_r21d_matches_torch_oracle():
     np.testing.assert_allclose(np.asarray(logits), ref_logits.numpy(), atol=1e-4)
 
 
+@pytest.mark.quick
 def test_converter_rejects_unconsumed():
     sd = {k: v.numpy() for k, v in _torch_oracle().state_dict().items()}
     sd["stray.weight"] = np.zeros(3, np.float32)
@@ -121,6 +122,7 @@ def test_converter_rejects_unconsumed():
         convert_state_dict(sd)
 
 
+@pytest.mark.quick
 def test_kinetics_preprocess_matches_torch():
     """The transform chain vs a torch implementation of the reference's
     ToFloatTensorInZeroOne -> Resize(128,171) -> Normalize -> CenterCrop(112)

@@ -15,6 +15,7 @@ from video_features_tpu.models.raft.convert import convert_state_dict
 from video_features_tpu.models.raft.extract_raft import InputPadder
 
 
+@pytest.mark.quick
 def test_converter_rejects_unconsumed():
     from test_reference_parity import _ref_import
 
@@ -26,6 +27,7 @@ def test_converter_rejects_unconsumed():
         convert_state_dict(sd)
 
 
+@pytest.mark.quick
 def test_input_padder_roundtrip():
     pad = InputPadder((135, 63))
     x = np.random.RandomState(0).randn(2, 135, 63, 3).astype(np.float32)
@@ -37,6 +39,7 @@ def test_input_padder_roundtrip():
     np.testing.assert_array_equal(p[:, :, 0], p[:, :, 1])
 
 
+@pytest.mark.quick
 def test_flow_viz_shapes():
     from video_features_tpu.utils.flow_viz import flow_to_image
 

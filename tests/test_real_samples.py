@@ -194,6 +194,7 @@ def test_pwc_flow_contract_on_real_sample(tmp_path):
     assert np.isfinite(flow).all()
 
 
+@pytest.mark.quick
 def test_sample_video_paths_txt_round_trip(tmp_path):
     """--file_with_video_paths consumes the reference's own list file
     format (ref sample/sample_video_paths.txt, utils/utils.py:153-204)."""

@@ -200,6 +200,7 @@ def test_pwc_matches_reference_source():
 # --- VGGish frontend + postprocessor ---------------------------------------
 
 
+@pytest.mark.quick
 def test_log_mel_matches_reference_source():
     """mel.waveform_to_examples vs the reference NumPy pipeline
     (mel_features.log_mel_spectrogram + the example framing of

@@ -29,6 +29,7 @@ EXPECTED_CLASS = {
 
 
 @pytest.mark.parametrize("feature_type", FEATURE_TYPES)
+@pytest.mark.quick
 def test_registry_dispatches_every_feature_type(feature_type, sample_video):
     cfg = ExtractionConfig(
         allow_random_init=True,

@@ -24,6 +24,7 @@ from video_features_tpu.models.vggish.model import build, postprocess
 
 # --- frontend ---------------------------------------------------------------
 
+@pytest.mark.quick
 def test_log_mel_shapes_and_silence():
     # 1 s of silence at 16 kHz: 98 STFT frames -> one (96, 64) example
     examples = mel.waveform_to_examples(np.zeros(16000, np.float32), 16000)
@@ -31,6 +32,7 @@ def test_log_mel_shapes_and_silence():
     np.testing.assert_allclose(examples, np.log(0.01), atol=1e-5)
 
 
+@pytest.mark.quick
 def test_pure_tone_lights_matching_mel_band():
     t = np.arange(16000 * 2) / 16000.0
     for hz in (440.0, 1000.0, 3000.0):
@@ -44,11 +46,13 @@ def test_pure_tone_lights_matching_mel_band():
         assert abs(int(band_energy.argmax()) - expected) <= 1
 
 
+@pytest.mark.quick
 def test_frame_drops_ragged_tail():
     framed = mel.frame(np.arange(10.0), window_length=4, hop_length=3)
     np.testing.assert_array_equal(framed, [[0, 1, 2, 3], [3, 4, 5, 6], [6, 7, 8, 9]])
 
 
+@pytest.mark.quick
 def test_resample_tone_preserved():
     from video_features_tpu.io.audio import resample
 
@@ -106,6 +110,7 @@ def test_converter_rejects_unconsumed():
         convert_state_dict(sd)
 
 
+@pytest.mark.quick
 def test_postprocessor_matches_torch_math():
     rng = np.random.RandomState(0)
     emb = rng.randn(5, 128).astype(np.float32)

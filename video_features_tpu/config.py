@@ -165,6 +165,16 @@ class ExtractionConfig:
     # instead of sharding the frame batch. The long-sequence regime:
     # activation memory per chip is O(L/n). CLIP only (the transformer).
     mesh_context: bool = False
+    # How --extraction_fps re-targets the frame grid (resnet*/raft/pwc —
+    # the families whose reference path re-encodes, ref utils/utils.py:
+    # 222-244):
+    #   'nearest'  — in-process nearest-frame selection on the native
+    #                decode grid (io/video._resample_indices): no ffmpeg
+    #                dependency, no transcode, bit-exact SOURCE pixels;
+    #   'reencode' — the reference's ffmpeg re-encode into --tmp_path:
+    #                reproduces its fps path bit-for-bit, including the
+    #                resampled/re-compressed pixels (needs ffmpeg).
+    fps_retarget: str = "nearest"
     # 3D-conv lowering for the I3D family (common/layers.py::Conv3DCompat):
     #   'auto'       — honor the VFT_CONV3D_IMPL env var, else direct;
     #   'direct'     — XLA's native 3D convolution (fastest when it works);
@@ -241,6 +251,18 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise ValueError(f"unknown attn core: {cfg.attn}")
     if cfg.conv3d_impl not in ("auto", "direct", "decomposed"):
         raise ValueError(f"unknown conv3d_impl: {cfg.conv3d_impl}")
+    if cfg.fps_retarget not in ("nearest", "reencode"):
+        raise ValueError(f"unknown fps_retarget: {cfg.fps_retarget}")
+    if cfg.fps_retarget == "reencode" and not (
+        cfg.feature_type in ("raft", "pwc")
+        or cfg.feature_type in RESNET_FEATURE_TYPES
+    ):
+        raise ValueError(
+            "--fps_retarget reencode mirrors the reference's ffmpeg fps "
+            "path, which only exists for resnet*/raft/pwc (ref utils/"
+            "utils.py:222-244); other extractors sample their own grids "
+            f"(got {cfg.feature_type!r})"
+        )
     if cfg.mesh_context and cfg.attn != "fused":
         raise ValueError(
             "--mesh_context injects the ring-attention core; it cannot "
@@ -275,6 +297,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--output_direct", action="store_true",
                    help="save as <stem>.npy instead of <stem>_<key>.npy")
     p.add_argument("--extraction_fps", type=float)
+    p.add_argument("--fps_retarget", default="nearest",
+                   choices=["nearest", "reencode"],
+                   help="how --extraction_fps re-targets the frame grid "
+                        "(resnet*/raft/pwc): in-process nearest-frame "
+                        "selection (default), or the reference's ffmpeg "
+                        "re-encode into --tmp_path")
     p.add_argument("--extract_method", type=str, help="e.g. fix_2 or uni_12")
     p.add_argument("--stack_size", type=int)
     p.add_argument("--step_size", type=int)

@@ -68,6 +68,27 @@ class BaseExtractor:
         existing outputs). I3D overrides with its streams."""
         return [self.feature_type]
 
+    def _fps_source(self, video_path: str):
+        """(decode_path, selection_fps) under the --fps_retarget policy.
+
+        nearest (default): decode the original and select frames on the
+        native grid in-process (io/video._resample_indices) — no ffmpeg,
+        no transcode. reencode: the reference's ffmpeg re-encode into
+        --tmp_path (ref utils/utils.py:222-244) — the decode path becomes
+        the re-encoded file, already on the target grid, so selection_fps
+        is None. Used by the extractors whose reference path re-encodes
+        (resnet*/raft/pwc; sanity_check restricts the flag to them)."""
+        fps = self.config.extraction_fps
+        if fps and getattr(self.config, "fps_retarget", "nearest") == "reencode":
+            from video_features_tpu.io.ffmpeg import reencode_video_with_diff_fps
+
+            with self.timer.stage("reencode"):
+                return (
+                    reencode_video_with_diff_fps(video_path, self.tmp_path, fps),
+                    None,
+                )
+        return video_path, fps
+
     def _already_done(self, entry) -> bool:
         files = expected_output_files(
             self.feature_keys(),

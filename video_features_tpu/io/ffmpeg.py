@@ -33,12 +33,26 @@ def require_ffmpeg() -> str:
 
 
 def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: float) -> str:
-    """Re-encode to target fps into tmp_path (ref utils/utils.py:222-244)."""
+    """Re-encode to target fps into tmp_path (ref utils/utils.py:222-244).
+
+    The output name carries a hash of the absolute source path: the
+    reference's bare ``{stem}_new_fps.mp4`` collides when two path-list
+    entries share a basename (a/clip.mp4 + b/clip.mp4), and concurrent
+    prepare() workers would race ffmpeg's ``-y`` overwrite against the
+    other video's decode — silently wrong features. The file is written
+    to a unique temp name and atomically renamed, so a concurrent reader
+    of the SAME source can never observe a truncated file."""
+    import hashlib
+
     ffmpeg = require_ffmpeg()
     os.makedirs(tmp_path, exist_ok=True)
-    new_path = os.path.join(tmp_path, f"{pathlib.Path(video_path).stem}_new_fps.mp4")
+    tag = hashlib.sha1(os.path.abspath(video_path).encode()).hexdigest()[:10]
+    stem = pathlib.Path(video_path).stem
+    new_path = os.path.join(tmp_path, f"{stem}_{tag}_new_fps_{extraction_fps:g}.mp4")
+    part = new_path + f".part{os.getpid()}.mp4"
     _run([ffmpeg, "-hide_banner", "-loglevel", "error", "-y", "-i", video_path,
-          "-filter:v", f"fps=fps={extraction_fps}", new_path])
+          "-filter:v", f"fps=fps={extraction_fps}", part])
+    os.replace(part, new_path)
     return new_path
 
 

@@ -177,10 +177,14 @@ class PairwiseFlowExtractor(BaseExtractor):
             for i in range(n_pairs):
                 show_flow_on_frame(flow[i], batch[i])
 
-    def extract(self, device, state, path_entry) -> Dict[str, np.ndarray]:
+    def extract(self, device, state, path_entry, source=None) -> Dict[str, np.ndarray]:
+        """``source``: an already-resolved (decode_path, selection_fps)
+        from prepare's over-cap handoff — reusing it avoids re-running an
+        ffmpeg re-encode the prepare pass already paid for."""
         video_path = video_path_of(path_entry)
         fps = (self.config.extraction_fps
                or probe(video_path, self.config.decoder).fps or 25.0)
+        decode_path, sel_fps = source or self._fps_source(video_path)
 
         flows: List[np.ndarray] = []
         timestamps_ms: List[float] = []
@@ -188,7 +192,7 @@ class PairwiseFlowExtractor(BaseExtractor):
         padder = None
         pending = None  # lag-1 window: fetch k after dispatching k+1
         for frame, ts in stream_frames(
-            video_path, self.config.extraction_fps, self.config.decoder
+            decode_path, sel_fps, self.config.decoder
         ):
             timestamps_ms.append(ts)
             frame = self._preprocess(frame)
@@ -237,6 +241,7 @@ class PairwiseFlowExtractor(BaseExtractor):
         video_path = video_path_of(path_entry)
         fps = (self.config.extraction_fps
                or probe(video_path, self.config.decoder).fps or 25.0)
+        decode_path, sel_fps = self._fps_source(video_path)
 
         windows: List[np.ndarray] = []
         n_pairs: List[int] = []
@@ -256,7 +261,7 @@ class PairwiseFlowExtractor(BaseExtractor):
             n_pairs.append(n)
 
         for frame, ts in stream_frames(
-            video_path, self.config.extraction_fps, self.config.decoder
+            decode_path, sel_fps, self.config.decoder
         ):
             count += 1
             frame = self._preprocess(frame)
@@ -264,7 +269,9 @@ class PairwiseFlowExtractor(BaseExtractor):
                 padder = self._make_padder(frame.shape[:2])
                 cap = self._window_cap(padder.pad(frame[None])[0])
             if count > cap:
-                return ("stream", path_entry)  # too big to prefetch whole
+                # too big to prefetch whole; hand the resolved decode
+                # source over so a completed re-encode isn't re-run
+                return ("stream", path_entry, (decode_path, sel_fps))
             timestamps_ms.append(ts)
             batch.append(frame)
             if len(batch) - 1 == self.batch_size:
@@ -293,7 +300,11 @@ class PairwiseFlowExtractor(BaseExtractor):
 
     def dispatch_prepared(self, device, state, path_entry, payload):
         if payload[0] == "stream":
-            return ("done", self.extract(device, state, payload[1]))
+            # ("stream", entry) from show_pred (no source resolved yet) or
+            # ("stream", entry, (decode_path, sel_fps)) from the over-cap
+            # handoff
+            source = payload[2] if len(payload) > 2 else None
+            return ("done", self.extract(device, state, payload[1], source))
         from video_features_tpu.parallel.sharding import place_batch
 
         windows, n_pairs, padder, fps, timestamps_ms = payload

@@ -115,6 +115,7 @@ class ExtractResNet(BaseExtractor):
     def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
         fps = self.config.extraction_fps
+        decode_path, sel_fps = self._fps_source(video_path)
         batch: List[np.ndarray] = []
         batches: List[np.ndarray] = []
         counts: List[int] = []
@@ -129,10 +130,12 @@ class ExtractResNet(BaseExtractor):
             counts.append(n)
 
         n_frames = 0
-        for frame, ts in stream_frames(video_path, fps, self.config.decoder):
+        for frame, ts in stream_frames(decode_path, sel_fps, self.config.decoder):
             n_frames += 1
             if n_frames > self.PIPELINE_MAX_FRAMES:
-                return ("stream", video_path)  # too big to prefetch whole
+                # hand the (possibly re-encoded) decode source over, with
+                # the matching selection fps
+                return ("stream", (decode_path, sel_fps))
             batch.append(frame)
             timestamps_ms.append(ts)
             if len(batch) == self.batch_size:
@@ -147,10 +150,13 @@ class ExtractResNet(BaseExtractor):
         actual_fps = fps or probe(video_path, self.config.decoder).fps or 25.0
         return batches, counts, actual_fps, timestamps_ms
 
-    def _extract_streaming(self, state, video_path) -> Dict[str, np.ndarray]:
+    def _extract_streaming(self, state, source) -> Dict[str, np.ndarray]:
         """Bounded-memory fallback: decode/preprocess one batch at a time
         on the consuming thread (the round-1 behavior; no video-level
-        prefetch, but host memory stays at one batch)."""
+        prefetch, but host memory stays at one batch). ``source`` is
+        prepare's (decode_path, selection_fps) — already past the
+        --fps_retarget policy."""
+        video_path, sel_fps = source
         fps = self.config.extraction_fps
         batch: List[np.ndarray] = []
         feats_out: List[np.ndarray] = []
@@ -170,7 +176,7 @@ class ExtractResNet(BaseExtractor):
             if self.config.show_pred:
                 show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
 
-        for frame, ts in stream_frames(video_path, fps, self.config.decoder):
+        for frame, ts in stream_frames(video_path, sel_fps, self.config.decoder):
             batch.append(frame)
             timestamps_ms.append(ts)
             if len(batch) == self.batch_size:
