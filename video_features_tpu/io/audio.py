@@ -1,11 +1,18 @@
 """Audio input: wav reading + resampling on the host.
 
 The reference reads wav via soundfile, normalizes int16 by 32768, mixes to
-mono, and resamples to 16 kHz with resampy (ref
-models/vggish/vggish_src/vggish_input.py:74-87 and :57-60). Neither
-soundfile nor resampy is assumed here: wav decode uses scipy.io.wavfile
-and resampling uses a polyphase filter (scipy.signal.resample_poly), which
-is the same class of kaiser-windowed sinc resampler resampy implements.
+mono, and resamples to 16 kHz with resampy's ``kaiser_best`` windowed
+sinc (ref models/vggish/vggish_src/vggish_input.py:74-87 and :48).
+Neither soundfile nor resampy is assumed here: wav decode uses
+scipy.io.wavfile, and the resampler is a NATIVE implementation of the
+same published kaiser_best algorithm (Smith's windowed-sinc
+interpolation with resampy 0.2.x's exact filter parameters), vectorized
+as a phase-decomposed polyphase matmul. The r4 advisor-era scipy
+``resample_poly`` substitute measured a 2.6e-3 relative-L2 drift on
+final VGGish embeddings — past the framework's 1e-3 budget — so the
+reference's resampler is reproduced exactly instead
+(tests/test_vggish.py pins parity against an independent per-sample
+re-derivation of the algorithm).
 
 For videos, the wav is ripped via io.ffmpeg when an ffmpeg binary exists;
 ``.wav`` inputs are consumed directly either way.
@@ -15,11 +22,18 @@ from __future__ import annotations
 
 import math
 import os
-from typing import Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 from scipy.io import wavfile
-from scipy.signal import resample_poly
+
+# resampy.filters.sinc_window('kaiser_best') parameters: 64 zero
+# crossings sampled at 2**9 points each, Kaiser beta tuned for ~-96 dB
+# stopband, cutoff rolled off to 0.9476 of Nyquist
+_NUM_ZEROS = 64
+_PRECISION = 9
+_ROLLOFF = 0.9475937167399596
+_BETA = 14.769656459379492
 
 
 def read_wav(path: str) -> Tuple[np.ndarray, int]:
@@ -39,12 +53,118 @@ def to_mono(data: np.ndarray) -> np.ndarray:
     return data.mean(axis=1) if data.ndim > 1 else data
 
 
+def _sinc_window() -> np.ndarray:
+    """Right half of the kaiser_best sinc table (resampy.filters)."""
+    num_bits = 2 ** _PRECISION
+    n = num_bits * _NUM_ZEROS
+    taps = np.arange(n + 1) / num_bits  # 0 .. num_zeros inclusive
+    sinc = _ROLLOFF * np.sinc(_ROLLOFF * taps)
+    window = np.kaiser(2 * n + 1, _BETA)[n:]
+    return sinc * window
+
+
+# (src_sr, dst_sr) -> (per-phase weight matrix rows, left extents, window len)
+_PHASE_CACHE: Dict[Tuple[int, int], tuple] = {}
+
+
+def _phase_filters(src_sr: int, dst_sr: int):
+    """Precompute kaiser_best tap weights per output phase.
+
+    With rational ratio L/M (L = dst/g, M = src/g) the fractional
+    position of output sample t against the input grid repeats every L
+    outputs, so the interpolated-table weights resampy computes per
+    sample (resampy.interpn) collapse to L fixed FIR vectors — the
+    windowed-sinc equivalent of a polyphase bank. Output t (phase
+    p = t mod L, block j = t // L) reads the contiguous input window
+    ``x[n - left_p : n - left_p + width_p]`` with ``n = (p*M)//L + j*M``;
+    each phase's outputs are then one strided-gather matmul.
+    """
+    key = (int(src_sr), int(dst_sr))
+    if key in _PHASE_CACHE:
+        return _PHASE_CACHE[key]
+    g = math.gcd(*key)
+    L, M = key[1] // g, key[0] // g
+    ratio = L / M
+    win = _sinc_window()
+    if ratio < 1:
+        win = win * ratio
+    delta = np.diff(win, append=0.0)
+    num_bits = 2 ** _PRECISION
+    scale = min(1.0, ratio)
+    index_step = int(scale * num_bits)
+
+    weights = []  # per phase: (left_taps_reversed ++ right_taps)
+    lefts = []
+    for p in range(L):
+        time = p * M / L
+        n = (p * M) // L
+        # left wing: taps for x[n], x[n-1], ...
+        frac = scale * (time - n)
+        index_frac = frac * num_bits
+        offset = int(index_frac)
+        eta = index_frac - offset
+        i_max = (len(win) - offset) // index_step
+        idx = offset + index_step * np.arange(i_max)
+        w_left = win[idx] + eta * delta[idx]
+        # right wing: taps for x[n+1], x[n+2], ...
+        frac = scale - frac
+        index_frac = frac * num_bits
+        offset = int(index_frac)
+        eta = index_frac - offset
+        k_max = (len(win) - offset) // index_step
+        idx = offset + index_step * np.arange(k_max)
+        w_right = win[idx] + eta * delta[idx]
+        weights.append(np.concatenate([w_left[::-1], w_right]))
+        lefts.append(i_max - 1)  # window starts at x[n - (i_max-1)]
+
+    width = max(len(w) for w in weights)
+    wmat = np.zeros((L, width))
+    for p, w in enumerate(weights):
+        wmat[p, : len(w)] = w
+    out = (wmat, np.asarray(lefts), L, M)
+    _PHASE_CACHE[key] = out
+    return out
+
+
 def resample(data: np.ndarray, src_sr: int, dst_sr: int) -> np.ndarray:
-    """Polyphase resampling src_sr -> dst_sr along axis 0."""
+    """resampy-kaiser_best-exact resampling along axis 0 (1-D or (n, ch)).
+
+    Boundary truncation matches resampy: taps that fall outside the
+    signal contribute zero (the zero-padded gather reproduces interpn's
+    wing clipping exactly).
+    """
     if src_sr == dst_sr:
         return data
-    g = math.gcd(int(src_sr), int(dst_sr))
-    return resample_poly(data, dst_sr // g, src_sr // g, axis=0).astype(np.float32)
+    x = np.asarray(data, dtype=np.float64)
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[:, None]
+    wmat, lefts, L, M = _phase_filters(src_sr, dst_sr)
+    n_in = x.shape[0]
+    # resampy 0.2.x sizes the output as int(n * sample_ratio) — i.e.
+    # FLOOR, not ceil (resampy.core.resample); one extra trailing sample
+    # would shift VGGish's 0.96 s frame count on boundary-length clips.
+    # Integer arithmetic = the exact floor, immune to float rounding.
+    n_out = (n_in * int(dst_sr)) // int(src_sr)
+    width = wmat.shape[1]
+    pad_lo = int(lefts.max())
+    xp = np.pad(x, ((pad_lo, width + M), (0, 0)))
+
+    out = np.empty((n_out, x.shape[1]), dtype=np.float64)
+    # one matmul per phase: rows are the strided windows of x this
+    # phase's outputs read; all windows share the phase's FIR vector
+    windows = np.lib.stride_tricks.sliding_window_view(xp, width, axis=0)
+    for p in range(L):
+        t = np.arange(p, n_out, L)
+        if not len(t):
+            continue
+        j = t // L
+        n = (p * M) // L + j * M
+        starts = n - lefts[p] + pad_lo
+        # sliding_window_view appends the window axis last: (t, ch, w)
+        out[t] = np.einsum("tsw,w->ts", windows[starts], wmat[p])
+    out = out.astype(np.float32)
+    return out[:, 0] if squeeze else out
 
 
 def load_audio_for_model(

@@ -7,10 +7,10 @@ Semantics follow the AudioSet feature pipeline the reference vendors
 125-7500 Hz with a zeroed DC bin, log with +0.01 offset, framed into
 non-overlapping 0.96 s examples of shape (96, 64).
 
-Divergence: the reference resamples with resampy's kaiser windowed-sinc;
-here io.audio uses scipy's polyphase resampler (same filter class,
-sub-1e-3 waveform differences). At native 16 kHz input they are
-identical.
+Resampling: io.audio implements the reference's resampy kaiser_best
+windowed sinc natively (the r4-era scipy polyphase substitute measured
+2.6e-3 relative L2 on final embeddings — past the 1e-3 budget; PARITY.md
+"Known intentional divergences" has the numbers).
 """
 
 from __future__ import annotations
