@@ -1,0 +1,97 @@
+"""Real-pretrained-weight golden parity (VERDICT r4 next #4).
+
+The build sandbox has zero egress (DNS fails — BASELINE.md r5 note), so
+no pretrained blob has ever been loadable here; every in-sandbox parity
+test necessarily runs random-init graphs against the reference SOURCES.
+This file is the real-weight complement: scripts/make_goldens.py (run on
+any networked host) fetches the same public checkpoints the reference
+auto-downloads, converts them, extracts features for real media, and
+commits small golden vectors into tests/goldens/. Wherever both the
+goldens and the converted weights exist, these tests prove the whole
+convert -> load -> extract path on the actual blobs.
+
+Skip semantics are deliberate and visible: missing goldens/weights skip
+with the exact command to produce them, so the gap is an actionable
+instruction, not a silent green.
+"""
+
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "goldens"
+WEIGHTS_DIR = os.environ.get("VFT_WEIGHTS_DIR", "")
+
+CASES = {
+    # golden file prefix -> (feature_type, weights file, input kind)
+    "CLIP-ViT-B-32": ("CLIP-ViT-B/32", "ViT-B-32.msgpack", "video"),
+    "vggish_torch": ("vggish_torch", "vggish-10086976.msgpack", "wav"),
+}
+
+
+def _goldens():
+    if not GOLDEN_DIR.is_dir():
+        return []
+    return sorted(GOLDEN_DIR.glob("*.npy"))
+
+
+@pytest.mark.parametrize("golden", _goldens() or [None])
+def test_real_weight_golden_parity(golden, tmp_path):
+    if golden is None:
+        pytest.skip(
+            "no goldens committed — zero-egress sandbox; on a networked "
+            "host run: python scripts/make_goldens.py --dest weights/"
+        )
+    prefix = next((p for p in CASES if golden.name.startswith(p)), None)
+    assert prefix, f"unrecognized golden {golden.name}"
+    feature_type, wfile, kind = CASES[prefix]
+    weights = os.path.join(WEIGHTS_DIR, wfile)
+    if not (WEIGHTS_DIR and os.path.exists(weights)):
+        pytest.skip(
+            f"converted weights absent ({weights!r}) — set VFT_WEIGHTS_DIR "
+            "to the make_goldens.py --dest directory"
+        )
+    stem = golden.stem[len(prefix) + 1:]
+    media = _find_media(stem, kind)
+    if media is None:
+        pytest.skip(f"input media {stem!r} not found on this host")
+
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.extract.registry import build_extractor
+
+    cfg = ExtractionConfig(
+        feature_type=feature_type,
+        video_paths=[media],
+        weights_path=weights,
+        extract_method="uni_12" if feature_type.startswith("CLIP") else None,
+        cpu=True,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+    )
+    (result,) = build_extractor(cfg, external_call=True)([0])
+    key = [k for k in result if k not in ("fps", "timestamps_ms")][0]
+    got = np.asarray(result[key], dtype=np.float32)
+    want = np.load(golden)
+    assert got.shape == want.shape
+    rel = float(np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-12))
+    # the framework-wide budget vs the reference's torch outputs
+    assert rel <= 1e-3, f"{golden.name}: relative L2 {rel}"
+
+
+def _find_media(stem: str, kind: str):
+    roots = [
+        pathlib.Path(__file__).parents[1],
+        pathlib.Path(__file__).parents[2] / "reference" / "sample",
+        pathlib.Path(os.environ.get("VFT_MEDIA_DIR", "/nonexistent")),
+    ]
+    exts = (".mp4", ".avi", ".mkv") if kind == "video" else (".wav",)
+    for root in roots:
+        if not root.is_dir():
+            continue
+        for ext in exts:
+            hits = list(root.rglob(stem + ext))
+            if hits:
+                return str(hits[0])
+    return None
