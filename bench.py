@@ -370,15 +370,35 @@ def bench_host_pipeline() -> dict:
     out["host_preprocess_pil_fps"] = round(
         len(frames) / (time.perf_counter() - t0), 1
     )
+    # PIL-chain thread scaling: --decode_workers runs this chain on W
+    # threads; PIL/numpy release the GIL for the heavy ops, but the
+    # measured curve (not an assumption) is what sizes workers-per-chip
+    # (VERDICT r4 next #5)
+    for w in (2, 4):
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(w) as pool:
+            list(pool.map(lambda _i: pil_chain(), range(w)))
+        out[f"host_preprocess_pil_{w}thread_fps"] = round(
+            w * len(frames) / (time.perf_counter() - t0), 1
+        )
     try:
         from video_features_tpu import native
 
         native.clip_preprocess_batch(frames, size=224)  # warm + build
+        # legacy key keeps its historical meaning: threads=0 (auto =
+        # min(cpu_count, 16)); the explicit thread counts get new keys so
+        # round-over-round comparisons stay apples-to-apples
         t0 = time.perf_counter()
         native.clip_preprocess_batch(frames, size=224)
         out["host_preprocess_native_fps"] = round(
             len(frames) / (time.perf_counter() - t0), 1
         )
+        for threads in (1, 2, 4):
+            t0 = time.perf_counter()
+            native.clip_preprocess_batch(frames, size=224, threads=threads)
+            out[f"host_preprocess_native_{threads}thread_fps"] = round(
+                len(frames) / (time.perf_counter() - t0), 1
+            )
     except Exception as e:  # noqa: BLE001 - native lib may not build
         out["host_preprocess_native_error"] = repr(e)
     return {"host_pipeline": out}

@@ -61,8 +61,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--dest", default="weights")
     p.add_argument("--videos", nargs="+",
-                   default=[os.path.join(REPO, "..", "reference", "sample",
-                                         "v_GGSY1Qvo990.mp4")])
+                   default=[os.path.join(REPO, "..", "reference", "sample", f)
+                            for f in ("v_GGSY1Qvo990.mp4",
+                                      "v_ZNVhz7ctTq0.mp4")])
     p.add_argument("--wavs", nargs="+", default=[],
                    help="16 kHz-or-not wav inputs for vggish_torch")
     args = p.parse_args(argv)

@@ -86,7 +86,14 @@ def _find_media(stem: str, kind: str):
         pathlib.Path(__file__).parents[2] / "reference" / "sample",
         pathlib.Path(os.environ.get("VFT_MEDIA_DIR", "/nonexistent")),
     ]
-    exts = (".mp4", ".avi", ".mkv") if kind == "video" else (".wav",)
+    # vggish accepts video containers too (audio ripped via ffmpeg), and
+    # make_goldens.py's no-wav fallback produces goldens from the sample
+    # videos — so the wav kind must search video extensions as well
+    exts = (
+        (".mp4", ".avi", ".mkv")
+        if kind == "video"
+        else (".wav", ".mp4", ".avi", ".mkv")
+    )
     for root in roots:
         if not root.is_dir():
             continue
