@@ -72,6 +72,13 @@ def main(argv=None) -> int:
 
     os.makedirs(GOLDEN_DIR, exist_ok=True)
     rc = 0
+    if not any(os.path.exists(v) for v in args.videos):
+        print("ERROR: none of the input videos exist — pass --videos "
+              "pointing at the reference sample clips (the defaults "
+              "assume the build sandbox's ../reference/ layout):")
+        for v in args.videos:
+            print(f"  missing: {v}")
+        return 1
     for feature_type, (fetch_key, wfile, kind) in FAMILIES.items():
         print(f"=== {feature_type}")
         r = subprocess.call(

@@ -294,3 +294,20 @@ def test_resampler_matches_resampy_kaiser_best_end_to_end():
     )
     print(f"\nembedding rel L2: native kaiser {rel:.2e}, "
           f"scipy polyphase {scipy_rel:.2e}")
+
+
+def test_resample_matches_real_resampy_when_installed():
+    """The direct cross-check the [oracle] extra exists for: on a
+    networked host with `pip install .[oracle]`, our native resampler is
+    compared against resampy ITSELF (not the offline re-derivation)."""
+    resampy = pytest.importorskip("resampy")
+
+    from video_features_tpu.io.audio import resample
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(15442).astype(np.float32)
+    for rate in (44100, 48000, 22050, 8000):
+        ours = resample(x, rate, 16000)
+        theirs = resampy.resample(x.astype(np.float64), rate, 16000)
+        assert len(ours) == len(theirs), rate
+        assert float(np.abs(ours - theirs).max()) < 1e-6, rate
