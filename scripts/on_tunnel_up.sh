@@ -71,4 +71,5 @@ else
   timeout 3600 python scripts/repro_i3d_conv3d.py | tee I3D_CONV3D_REPRO.txt \
     || echo "repro ladder rc!=0 (verdicts above are still the data)"
 fi
-echo "done — commit BENCH_r05_local.json + *_VALIDATION.txt + I3D_CONV3D_REPRO.txt"
+echo "done — commit BENCH_r05_local.json + *_VALIDATION.txt +"
+echo "I3D_CONV3D_REPRO.txt + corr_routing.json (measured auto-routing)"

@@ -169,3 +169,53 @@ def test_tensor_transforms():
     ref = torch.tensor([-30.0, 0.0, 30.0]).clamp(-20, 20)
     ref = (128 + 255 / 40 * ref).round().numpy()
     np.testing.assert_array_equal(q, ref)
+
+
+def test_corr_auto_threshold_data_driven(tmp_path, monkeypatch):
+    """'auto' routing loads a measured threshold when one exists
+    (corr_routing.json written by scripts/validate_corr_tpu.py on chip),
+    falls back to the design default otherwise, and never crashes on a
+    malformed file."""
+    import json
+
+    from video_features_tpu.ops import correlation as C
+
+    # default: no file
+    monkeypatch.setenv("VFT_CORR_ROUTING", str(tmp_path / "absent.json"))
+    C._auto_threshold.cache_clear()
+    assert C._auto_threshold() == C.DEFAULT_PALLAS_MIN_HW
+
+    # measured override wins
+    routing = tmp_path / "corr_routing.json"
+    routing.write_text(json.dumps({"pallas_min_hw": 1024, "evidence": {}}))
+    monkeypatch.setenv("VFT_CORR_ROUTING", str(routing))
+    C._auto_threshold.cache_clear()
+    assert C._auto_threshold() == 1024
+
+    # malformed -> silent default (routing must never kill an extraction)
+    routing.write_text("{not json")
+    C._auto_threshold.cache_clear()
+    assert C._auto_threshold() == C.DEFAULT_PALLAS_MIN_HW
+
+    # nonsense values -> default (r5 review: 0/negative/bool must not
+    # route every tiny shape to the kernel)
+    for bad in ('{"pallas_min_hw": 0}', '{"pallas_min_hw": -4}',
+                '{"pallas_min_hw": true}', '{"pallas_min_hw": "64"}'):
+        routing.write_text(bad)
+        C._auto_threshold.cache_clear()
+        assert C._auto_threshold() == C.DEFAULT_PALLAS_MIN_HW, bad
+
+    # measured on different hardware -> default (device_kind scoping)
+    routing.write_text(json.dumps(
+        {"pallas_min_hw": 1024, "device_kind": "TPU v99"}
+    ))
+    C._auto_threshold.cache_clear()
+    assert C._auto_threshold() == C.DEFAULT_PALLAS_MIN_HW
+    import jax
+
+    routing.write_text(json.dumps(
+        {"pallas_min_hw": 1024, "device_kind": jax.devices()[0].device_kind}
+    ))
+    C._auto_threshold.cache_clear()
+    assert C._auto_threshold() == 1024
+    C._auto_threshold.cache_clear()
