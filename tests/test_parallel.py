@@ -439,14 +439,20 @@ def test_device_pipeline_isolates_corrupt_video(sample_video, tmp_path):
     )
 
 
-def test_mesh_i3d_sequence_parallel_matches_single_device(sample_video, tmp_path):
+@pytest.mark.parametrize("impl", ["auto", "decomposed"])
+def test_mesh_i3d_sequence_parallel_matches_single_device(sample_video, tmp_path, impl):
     """I3D mesh mode: the stack's frame axis shards over 'data' inside
     the fused per-stream pipelines — for the rgb stream that is I3D's own
     temporal convs/pools resharding with GSPMD halos. Matches the
     single-device run to reduction-order tolerance (uneven 11-frame
     shards repartition the conv reductions). The flow streams' pair-view
     halos are covered by test_mesh_raft_sequence_parallel... (same
-    mechanism, and the PWC double-compile here would dominate CI)."""
+    mechanism, and the PWC double-compile here would dominate CI).
+
+    impl='decomposed' additionally exercises the conv3d TPU-crash
+    workaround (bench.py's chip default) on the mesh: the decomposition
+    slices exactly the sharded frame axis with strides, so GSPMD must
+    insert the halo exchanges there too."""
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.parallel.sharding import make_mesh
 
@@ -458,6 +464,7 @@ def test_mesh_i3d_sequence_parallel_matches_single_device(sample_video, tmp_path
         video_paths=[sample_video],
         stack_size=10,
         step_size=24,
+        conv3d_impl=impl,
         tmp_path=str(tmp_path / "t"),
         output_path=str(tmp_path / "o"),
     )
@@ -494,3 +501,4 @@ def test_multihost_out_kwargs_replicates_only_on_multiprocess(monkeypatch):
     kw = multihost_out_kwargs(mesh)
     assert kw["out_shardings"].spec == P()
     assert multihost_out_kwargs(jax.devices()[0]) == {}
+
