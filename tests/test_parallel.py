@@ -501,4 +501,3 @@ def test_multihost_out_kwargs_replicates_only_on_multiprocess(monkeypatch):
     kw = multihost_out_kwargs(mesh)
     assert kw["out_shardings"].spec == P()
     assert multihost_out_kwargs(jax.devices()[0]) == {}
-

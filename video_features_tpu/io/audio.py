@@ -152,17 +152,20 @@ def resample(data: np.ndarray, src_sr: int, dst_sr: int) -> np.ndarray:
 
     out = np.empty((n_out, x.shape[1]), dtype=np.float64)
     # one matmul per phase: rows are the strided windows of x this
-    # phase's outputs read; all windows share the phase's FIR vector
+    # phase's outputs read; all windows share the phase's FIR vector.
+    # Window starts advance by exactly M per output within a phase, so
+    # windows[base::M] is a strided VIEW (no per-row gather copy) and
+    # the einsum runs straight off it.
     windows = np.lib.stride_tricks.sliding_window_view(xp, width, axis=0)
     for p in range(L):
-        t = np.arange(p, n_out, L)
-        if not len(t):
+        count = len(range(p, n_out, L))
+        if not count:
             continue
-        j = t // L
-        n = (p * M) // L + j * M
-        starts = n - lefts[p] + pad_lo
+        base = (p * M) // L - lefts[p] + pad_lo
         # sliding_window_view appends the window axis last: (t, ch, w)
-        out[t] = np.einsum("tsw,w->ts", windows[starts], wmat[p])
+        out[p::L] = np.einsum(
+            "tsw,w->ts", windows[base::M][:count], wmat[p]
+        )
     out = out.astype(np.float32)
     return out[:, 0] if squeeze else out
 
