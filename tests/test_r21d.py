@@ -241,3 +241,28 @@ def test_agg_cap_accounts_for_widened_transfer(sample_video, tmp_path):
     payload = ([(stack, 1)], [(0, 16)] * n_slices)
     assert make("on").agg_key(payload) is not None
     assert make("off").agg_key(payload) is None
+
+
+@pytest.mark.quick
+def test_r21d_conv3d_decomposed_matches_direct():
+    """R(2+1)D's factorized convs now ride Conv3DCompat too (r5): the
+    decomposed lowering — (1,k,k) collapses to one 2D conv, (k,1,1) to a
+    strided 3-term sum — must match the direct lowering on the same
+    params. A truncated stem+two-stage net keeps this in the quick-tier
+    budget while still covering all three decomposed paths: both
+    factorized kernel shapes AND the strided 1x1x1 downsample (stage 2
+    opens with stride 2)."""
+    import jax
+
+    from video_features_tpu.models.r21d.model import R2Plus1D
+
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(1, 8, 56, 56, 3).astype(np.float32)
+    )
+    direct = R2Plus1D(layers=(1, 1), conv_impl="direct")
+    decomp = R2Plus1D(layers=(1, 1), conv_impl="decomposed")
+    params = direct.init(jax.random.PRNGKey(0), x)["params"]
+    f1, l1 = direct.apply({"params": params}, x)
+    f2, l2 = decomp.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=2e-4)

@@ -175,7 +175,8 @@ class ExtractionConfig:
     #                reproduces its fps path bit-for-bit, including the
     #                resampled/re-compressed pixels (needs ffmpeg).
     fps_retarget: str = "nearest"
-    # 3D-conv lowering for the I3D family (common/layers.py::Conv3DCompat):
+    # 3D-conv lowering for the 3D-conv families, i3d + r21d
+    # (common/layers.py::Conv3DCompat):
     #   'auto'       — honor the VFT_CONV3D_IMPL env var, else direct;
     #   'direct'     — XLA's native 3D convolution (fastest when it works);
     #   'decomposed' — sum of kt 2D convs over strided time slices, byte-
@@ -343,11 +344,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "Pallas flash kernel, or the XLA blockwise core")
     p.add_argument("--conv3d_impl", default="auto",
                    choices=["auto", "direct", "decomposed"],
-                   help="I3D 3D-conv lowering: XLA's native 3D conv, or "
-                        "the checkpoint-identical sum-of-2D-convs "
-                        "decomposition (the workaround for TPU stacks "
-                        "whose 3D-conv compile crashes); auto honors "
-                        "VFT_CONV3D_IMPL, else direct")
+                   help="3D-conv lowering (i3d/r21d): XLA's native 3D "
+                        "conv, or the checkpoint-identical "
+                        "sum-of-2D-convs decomposition (the workaround "
+                        "for TPU stacks whose 3D-conv compile crashes); "
+                        "auto honors VFT_CONV3D_IMPL, else direct")
     p.add_argument("--video_batch", type=int, default=1,
                    help="aggregate up to N videos' prepared batches into "
                         "one device dispatch (CLIP/ResNet/R21D); 1 = off")

@@ -50,6 +50,15 @@ def conv3d_impl() -> str:
     return impl
 
 
+def explicit_conv3d_impl(config) -> str | None:
+    """The per-extractor --conv3d_impl contract, shared by the 3D-conv
+    families (i3d, r21d): an explicit direct/decomposed choice threads
+    into THAT extractor's Conv3DCompat modules; 'auto' (None) defers to
+    the VFT_CONV3D_IMPL env var at trace time."""
+    impl = getattr(config, "conv3d_impl", "auto")
+    return None if impl in (None, "auto") else impl
+
+
 class Conv3DCompat(nn.Module):
     """3D conv with a checkpoint-identical choice of TPU lowering.
 

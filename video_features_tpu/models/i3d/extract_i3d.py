@@ -125,14 +125,12 @@ class ExtractI3D(BaseExtractor):
         # surplus outputs are sliced off. Mesh runs pin B=1 — there the stack's
         # FRAME axis is what shards (sequence parallelism).
         self.stack_batch = max(int(self.config.batch_size or 1), 1)
-        # --conv3d_impl: an explicit direct/decomposed choice is threaded
-        # into THIS extractor's model (Conv3DCompat.impl) — never written
-        # to the process env, so two extractors with different configs in
-        # one process can't clobber each other's lowering. 'auto' (None)
-        # defers to the VFT_CONV3D_IMPL env var at trace time, which is
-        # how bench.py selects the safe lowering process-wide on TPU.
-        impl = getattr(self.config, "conv3d_impl", "auto")
-        self.conv_impl = None if impl in (None, "auto") else impl
+        # --conv3d_impl: threads into THIS extractor's model only — never
+        # written to the process env, so two extractors with different
+        # configs in one process can't clobber each other's lowering
+        from video_features_tpu.models.common.layers import explicit_conv3d_impl
+
+        self.conv_impl = explicit_conv3d_impl(self.config)
         self._host_params: Dict[str, object] = {}
 
     def feature_keys(self):

@@ -76,6 +76,11 @@ class ExtractR21D(BaseExtractor):
         # stacks per device call; the reference's --batch_size batches
         # frames for 2D nets, here it batches windows
         self.batch_size = max(int(self.config.batch_size or 1), 1)
+        # --conv3d_impl threads into this extractor's model only (shared
+        # contract with i3d — common/layers.py::explicit_conv3d_impl)
+        from video_features_tpu.models.common.layers import explicit_conv3d_impl
+
+        self.conv_impl = explicit_conv3d_impl(self.config)
         self._host_params = None
 
     def _load_host_params(self):
@@ -105,7 +110,7 @@ class ExtractR21D(BaseExtractor):
         )
 
         dt = compute_dtype(self.config)
-        model = build(dtype=dt)
+        model = build(dtype=dt, conv_impl=self.conv_impl)
         params = self._load_host_params()
         if dt != jnp.float32:
             params = cast_floats_for_compute(params, dt, exclude=("fc",))

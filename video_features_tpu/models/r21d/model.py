@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from video_features_tpu.models.common.layers import EvalBatchNorm
+from video_features_tpu.models.common.layers import Conv3DCompat, EvalBatchNorm
 
 R21D_FEATURE_DIM = 512
 
@@ -41,26 +41,27 @@ class Conv2Plus1D(nn.Module):
     features: int
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str | None = None  # Conv3DCompat lowering (VFT_CONV3D_IMPL)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Conv(
+        x = Conv3DCompat(
             self.mid,
             (1, 3, 3),
-            strides=(1, self.stride, self.stride),
-            padding=[(0, 0), (1, 1), (1, 1)],
-            use_bias=False,
+            (1, self.stride, self.stride),
+            [(0, 0), (1, 1), (1, 1)],
             dtype=self.dtype,
+            impl=self.conv_impl,
             name="spatial",
         )(x)
         x = nn.relu(EvalBatchNorm(name="bn_mid")(x))
-        x = nn.Conv(
+        x = Conv3DCompat(
             self.features,
             (3, 1, 1),
-            strides=(self.stride, 1, 1),
-            padding=[(1, 1), (0, 0), (0, 0)],
-            use_bias=False,
+            (self.stride, 1, 1),
+            [(1, 1), (0, 0), (0, 0)],
             dtype=self.dtype,
+            impl=self.conv_impl,
             name="temporal",
         )(x)
         return x
@@ -71,6 +72,7 @@ class BasicBlock(nn.Module):
     stride: int = 1
     downsample: bool = False
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -79,17 +81,20 @@ class BasicBlock(nn.Module):
         # and reuses it for BOTH factorized convs of the block
         mid = midplanes(in_ch, self.planes)
         identity = x
-        out = Conv2Plus1D(mid, self.planes, self.stride, self.dtype, name="conv1")(x)
+        out = Conv2Plus1D(mid, self.planes, self.stride, self.dtype,
+                          self.conv_impl, name="conv1")(x)
         out = nn.relu(EvalBatchNorm(name="bn1")(out))
-        out = Conv2Plus1D(mid, self.planes, 1, self.dtype, name="conv2")(out)
+        out = Conv2Plus1D(mid, self.planes, 1, self.dtype,
+                          self.conv_impl, name="conv2")(out)
         out = EvalBatchNorm(name="bn2")(out)
         if self.downsample:
-            identity = nn.Conv(
+            identity = Conv3DCompat(
                 self.planes,
                 (1, 1, 1),
-                strides=(self.stride,) * 3,
-                use_bias=False,
+                (self.stride,) * 3,
+                [(0, 0)] * 3,
                 dtype=self.dtype,
+                impl=self.conv_impl,
                 name="downsample_conv",
             )(x)
             identity = EvalBatchNorm(name="downsample_bn")(identity)
@@ -102,26 +107,27 @@ class R2Plus1D(nn.Module):
     layers: Sequence[int] = (2, 2, 2, 2)
     num_classes: int = 400
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        x = nn.Conv(
+        x = Conv3DCompat(
             45,
             (1, 7, 7),
-            strides=(1, 2, 2),
-            padding=[(0, 0), (3, 3), (3, 3)],
-            use_bias=False,
+            (1, 2, 2),
+            [(0, 0), (3, 3), (3, 3)],
             dtype=self.dtype,
+            impl=self.conv_impl,
             name="stem_conv1",
         )(x)
         x = nn.relu(EvalBatchNorm(name="stem_bn1")(x))
-        x = nn.Conv(
+        x = Conv3DCompat(
             64,
             (3, 1, 1),
-            strides=(1, 1, 1),
-            padding=[(1, 1), (0, 0), (0, 0)],
-            use_bias=False,
+            (1, 1, 1),
+            [(1, 1), (0, 0), (0, 0)],
             dtype=self.dtype,
+            impl=self.conv_impl,
             name="stem_conv2",
         )(x)
         x = nn.relu(EvalBatchNorm(name="stem_bn2")(x))
@@ -133,7 +139,8 @@ class R2Plus1D(nn.Module):
             for b in range(n_blocks):
                 s = stride if b == 0 else 1
                 need_ds = s != 1 or in_planes != planes
-                x = BasicBlock(planes, s, need_ds, self.dtype, name=f"layer{stage + 1}_{b}")(x)
+                x = BasicBlock(planes, s, need_ds, self.dtype, self.conv_impl,
+                               name=f"layer{stage + 1}_{b}")(x)
                 in_planes = planes
 
         # fp32 pool + head: features are the user-facing contract
@@ -142,8 +149,10 @@ class R2Plus1D(nn.Module):
         return feats, logits
 
 
-def build(num_classes: int = 400, dtype=jnp.float32) -> R2Plus1D:
-    return R2Plus1D(num_classes=num_classes, dtype=dtype)
+def build(
+    num_classes: int = 400, dtype=jnp.float32, conv_impl: str | None = None
+) -> R2Plus1D:
+    return R2Plus1D(num_classes=num_classes, dtype=dtype, conv_impl=conv_impl)
 
 
 def init_params(seed: int = 0, num_classes: int = 400):
