@@ -16,6 +16,13 @@ from video_features_tpu.ops.pallas.correlation_kernel import local_correlation_p
         ((2, 16, 16, 24), 8),   # H divides tile
         ((1, 8, 13, 17), 8),    # ragged H and W
         ((1, 32, 8, 8), 8),     # small spatial, single tile
+        # the EXACT on-chip validation tiers (scripts/validate_corr_tpu.py)
+        # at default tiling, so interpret-mode parity covers the same
+        # (shape, grid) configurations the compiled runs will execute —
+        # N reduced (the kernel grid is per-pair; more pairs repeat it)
+        ((2, 64, 16, 16), None),   # tier 1, pyramid level ~4
+        ((2, 64, 32, 32), None),   # tier 2, level 3
+        ((2, 32, 64, 64), None),   # tier 3, level 2 (the hottest volume)
     ],
 )
 def test_pallas_matches_xla(shape, tile_h):
@@ -23,9 +30,10 @@ def test_pallas_matches_xla(shape, tile_h):
     f1 = rng.randn(*shape).astype(np.float32)
     f2 = rng.randn(*shape).astype(np.float32)
     ref = np.asarray(local_correlation(jnp.asarray(f1), jnp.asarray(f2), method="xla"))
+    kw = {} if tile_h is None else {"tile_h": tile_h}  # None = default tiling
     out = np.asarray(
         local_correlation_pallas(
-            jnp.asarray(f1), jnp.asarray(f2), tile_h=tile_h, interpret=True
+            jnp.asarray(f1), jnp.asarray(f2), interpret=True, **kw
         )
     )
     assert out.shape == ref.shape == (shape[0], 81, shape[2], shape[3])
