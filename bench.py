@@ -107,18 +107,23 @@ def bench_clip(
     tmp: str,
     dtype: str = "float32",
     video_batch: int = 1,
+    preprocess: str = "host",
+    videos: list = None,
 ) -> dict:
     from video_features_tpu.config import ExtractionConfig
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
     from video_features_tpu.parallel.devices import resolve_devices
 
+    video_paths = list(videos) if videos else [video] * n_videos
+    n_videos = len(video_paths)
     cfg = ExtractionConfig(
         allow_random_init=True,
         feature_type="CLIP-ViT-B/32",
-        video_paths=[video] * n_videos,
+        video_paths=video_paths,
         extract_method=CLIP_EXTRACT_METHOD,
         dtype=dtype,
         video_batch=video_batch,
+        preprocess=preprocess,
         tmp_path=os.path.join(tmp, "t"),
         output_path=os.path.join(tmp, "o"),
     )
@@ -317,7 +322,12 @@ def bench_host_pipeline() -> dict:
     )
     from video_features_tpu.utils.synth import synth_video
 
-    out = {}
+    from video_features_tpu import native
+
+    # the denominator every thread-scaling curve below divides into:
+    # on a 1-core container no fan-out can win, and native's
+    # _resolve_threads clamps accordingly (the dead-knob fix)
+    out = {"host_cpu_count": native.cpu_budget()}
     with tempfile.TemporaryDirectory() as tmp:
         video = synth_video(os.path.join(tmp, "host.mp4"), **CLIP_SPEC)
 
@@ -607,6 +617,11 @@ def _sub_clip_e2e() -> dict:
         video = synth_video(os.path.join(tmp, "bench.mp4"), **CLIP_SPEC)
         agg = bench_clip(n_videos, video, tmp, video_batch=group)
         solo = bench_clip(n_videos, video, tmp)
+        # --preprocess device on the SAME workload: raw uint8 H2D + fused
+        # on-chip resize/crop/normalize/encode vs the host PIL chain — the
+        # acceptance gate is device >= host end-to-end
+        dev = bench_clip(n_videos, video, tmp, video_batch=group,
+                         preprocess="device")
     return {
         "clip_vps": agg["best"],
         "clip_agg_median_vps": agg["median"],
@@ -614,6 +629,10 @@ def _sub_clip_e2e() -> dict:
         "clip_solo_vps": solo["best"],
         "clip_solo_median_vps": solo["median"],
         "clip_solo_passes": solo["passes"],
+        "clip_device_pre_vps": dev["best"],
+        "clip_device_pre_median_vps": dev["median"],
+        "clip_device_pre_passes": dev["passes"],
+        "clip_device_pre_speedup_vs_host": round(dev["best"] / agg["best"], 3),
     }
 
 
@@ -630,6 +649,82 @@ def _sub_clip_bf16() -> dict:
         "clip_bf16_vps": bf16["best"],
         "clip_bf16_median_vps": bf16["median"],
         "clip_bf16_passes": bf16["passes"],
+    }
+
+
+def _sub_clip_mixed() -> dict:
+    """Mixed-RESOLUTION aggregation workload (the honesty note in
+    bench_config: the headline fuses N copies of one video, --video_batch's
+    best case). Here 8 videos at 4 source resolutions form 2 spatial
+    buckets; under --preprocess device the bucket id joins agg_key, so
+    same-bucket videos still fuse while their per-video resize matrices
+    ride along — this measures what the bucket-grid + agg_key path
+    actually delivers on a heterogeneous corpus, host vs device."""
+    from video_features_tpu.utils.synth import synth_video
+
+    # (h, w) pairs chosen so each bucket holds TWO distinct resolutions:
+    # (360,640)/(352,620) -> (384,640); (240,426)/(232,420) -> (256,448)
+    specs = [(360, 640), (352, 620), (240, 426), (232, 420)] * 2
+    with tempfile.TemporaryDirectory() as tmp:
+        videos = [
+            synth_video(os.path.join(tmp, f"m{i}.mp4"), n_frames=60,
+                        width=w, height=h, seed=i)
+            for i, (h, w) in enumerate(specs)
+        ]
+        host = bench_clip(0, None, tmp, video_batch=4, videos=videos)
+        dev = bench_clip(0, None, tmp, video_batch=4, videos=videos,
+                         preprocess="device")
+    return {
+        "clip_mixed_host_vps": host["best"],
+        "clip_mixed_host_passes": host["passes"],
+        "clip_mixed_device_vps": dev["best"],
+        "clip_mixed_device_passes": dev["passes"],
+        "clip_mixed_device_speedup_vs_host": round(
+            dev["best"] / host["best"], 3
+        ),
+    }
+
+
+def _sub_device_preprocess() -> dict:
+    """The fused device-preprocess program ALONE (no encoder): uint8
+    bucket-padded frames -> PIL-semantics bicubic resize + crop +
+    normalize, jitted, at the headline CLIP_SPEC resolution. Spawned with
+    JAX_PLATFORMS=cpu so it rides next to the host_preprocess_* keys
+    (same backend, same 32-frame batch) without ever dialing a tunnel;
+    on-chip numbers come from the e2e clip_device_pre_* keys instead."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.ops.preprocess import (
+        CLIP_MEAN,
+        CLIP_STD,
+        device_preprocess_frames,
+    )
+    from video_features_tpu.ops.resize import fused_resize_crop_banded
+    from video_features_tpu.ops.window import pad_hw, spatial_bucket
+
+    rng = np.random.RandomState(0)
+    h, w = CLIP_SPEC["height"], CLIP_SPEC["width"]
+    frames = rng.randint(0, 255, (32, h, w, 3)).astype(np.uint8)
+    bh, bw = spatial_bucket(h, w)
+    wt_y, idx_y, wt_x, idx_x = fused_resize_crop_banded(
+        h, w, 224, 224, "bicubic", pad_h=bh, pad_w=bw
+    )
+    x = jnp.asarray(pad_hw(frames, bh, bw))
+    wy_d = (jnp.asarray(wt_y), jnp.asarray(idx_y))
+    wx_d = (jnp.asarray(wt_x), jnp.asarray(idx_x))
+    fn = jax.jit(
+        lambda x, wy, wx: device_preprocess_frames(x, wy, wx, CLIP_MEAN, CLIP_STD)
+    )
+    jax.block_until_ready(fn(x, wy_d, wx_d))  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x, wy_d, wx_d))
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "device_preprocess_fps": round(len(frames) / best, 1),
+        "device_preprocess_backend": jax.default_backend(),
     }
 
 
@@ -718,6 +813,8 @@ def _sub_i3d_agg() -> dict:
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
+    "clip_mixed": _sub_clip_mixed,
+    "device_preprocess": _sub_device_preprocess,
     "clip_device_only": lambda: bench_clip_device_only(),
     "i3d_compile_probe": _sub_i3d_compile_probe,
     "conv3d_direct_probe": _sub_conv3d_direct_probe,
@@ -737,17 +834,20 @@ def _run_sub_part(name: str) -> None:
     print(_SUB_MARK + json.dumps(part()))
 
 
-def _spawn_sub(name: str, timeout_s: float) -> dict:
+def _spawn_sub(name: str, timeout_s: float, env: dict = None) -> dict:
     """Run one bench part in a child process; a TPU-helper crash (or hang)
     there costs only this part's keys, never the parent's collected
     numbers. Failures come back as a single `<name>_error` string so the
-    BENCH artifact records WHAT died, not just an absence."""
+    BENCH artifact records WHAT died, not just an absence. ``env`` adds
+    overrides on top of the inherited environment (e.g. pinning a part to
+    JAX_PLATFORMS=cpu so it can never dial the chip tunnel)."""
     import subprocess
 
     argv = [sys.executable, os.path.abspath(__file__), "--sub", name]
     try:
         proc = subprocess.run(
-            argv, capture_output=True, text=True, timeout=timeout_s
+            argv, capture_output=True, text=True, timeout=timeout_s,
+            env={**os.environ, **env} if env else None,
         )
     except subprocess.TimeoutExpired:
         return {f"{name}_error": f"timed out after {timeout_s:.0f}s"}
@@ -847,13 +947,25 @@ def main() -> None:
         # synthetic video, so every row shares one agg_key — grouping
         # efficiency is the best case for --video_batch. Heterogeneous
         # corpora bucket into more keys and flush more padded partial
-        # groups; the unaggregated comparison ships in clip_solo_*.
+        # groups; the unaggregated comparison ships in clip_solo_* and
+        # the heterogeneous one in clip_mixed_* (2 spatial buckets, 4
+        # source resolutions).
         "clip_agg_workload": "same-shape best case (N copies of one video)",
+        # the headline number's preprocess path; the --preprocess device
+        # comparison ships in clip_device_pre_* / clip_mixed_device_*
+        "preprocess_mode": "host",
     }
 
     # pure-host part FIRST, before any device probe: even a tunnel-dead
     # round carries measured numbers in its artifact (r02-r04 carried none)
     extra.update(bench_host_pipeline())
+    emit()
+    # the fused device-preprocess program next to the host_preprocess_*
+    # keys, in a CPU-pinned child (same backend as the PIL numbers; can't
+    # dial a tunnel, so it's safe before the probe)
+    extra.setdefault("host_pipeline", {}).update(
+        _spawn_sub("device_preprocess", 600.0, env={"JAX_PLATFORMS": "cpu"})
+    )
     emit()
 
     if not _probe_backend(fatal=False):
@@ -888,6 +1000,8 @@ def main() -> None:
     # second XLA compile hits the persistent cache on re-runs
     if os.environ.get("BENCH_BF16") != "0":
         part("clip_bf16")
+    # heterogeneous-corpus aggregation, host vs --preprocess device
+    part("clip_mixed")
     part("clip_device_only")
     part("pallas_corr")
 

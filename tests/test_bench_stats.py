@@ -149,6 +149,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     stub_results = {
         "clip_e2e": {"clip_vps": 4.0, "clip_solo_vps": 3.5},
         "clip_bf16": {"clip_bf16_vps": 5.0},
+        "clip_mixed": {"clip_mixed_vps": 2.0},
         "clip_device_only": {"clip_device_only_ips_fp32": 100.0},
         "pallas_corr": {},
         "i3d_compile_probe": {"i3d_conv3d_impl": "direct"},
@@ -156,8 +157,13 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "i3d_agg": {"i3d_agg_vps": 0.5},
         "i3d_device_only": {"i3d_raft_device_only_sps": 0.6},
     }
-    monkeypatch.setattr(bench, "_spawn_sub",
-                        lambda name, timeout: dict(stub_results[name]))
+    # device_preprocess is the CPU-pinned child folded into host_pipeline,
+    # not a top-level part — stub it apart from stub_results
+    monkeypatch.setattr(
+        bench, "_spawn_sub",
+        lambda name, timeout, **kw: ({"device_preprocess_fps": 11.0}
+                                     if name == "device_preprocess"
+                                     else dict(stub_results[name])))
     monkeypatch.setattr(bench, "bench_host_pipeline",
                         lambda: {"host_pipeline": {"host_decode_cv2_fps": 1.0}})
     monkeypatch.setattr(bench, "_probe_backend",
@@ -179,6 +185,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     for part in stub_results.values():
         for key, val in part.items():
             assert final["extra"][key] == val
+    assert final["extra"]["host_pipeline"]["device_preprocess_fps"] == 11.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -203,7 +210,9 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
                         lambda timeout_s=180.0, fatal=True: False)
     monkeypatch.delenv("BENCH_MEASURE_BASELINE", raising=False)
 
-    def boom(name, timeout):  # no device part may run on a dead backend
+    def boom(name, timeout, **kw):  # no device part may run on a dead backend
+        if name == "device_preprocess":  # JAX_PLATFORMS=cpu pinned: tunnel-safe
+            return {"device_preprocess_fps": 7.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)
@@ -214,6 +223,7 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
     assert final["value"] is None
     assert "unreachable" in final["extra"]["fatal"]
     assert final["extra"]["host_pipeline"]["host_decode_cv2_fps"] == 9.0
+    assert final["extra"]["host_pipeline"]["device_preprocess_fps"] == 7.0
 
 
 @pytest.mark.quick
@@ -227,7 +237,7 @@ def test_i3d_compile_probe_failure_skips_i3d_parts(monkeypatch, capsys):
 
     ran = []
 
-    def spawn(name, timeout):
+    def spawn(name, timeout, **kw):
         ran.append(name)
         if name == "i3d_compile_probe":
             return {"i3d_compile_probe_error": "rc=3: helper died"}

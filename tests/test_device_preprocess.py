@@ -1,0 +1,292 @@
+"""--preprocess device: fused uint8 ingest (ISSUE PR 1 tentpole).
+
+Chain parity is pinned against the host PIL oracle (ops/preprocess.py),
+end-to-end CLIP/ResNet features against the host path with a drift
+budget, and the config surface (flag validation + compilation cache)
+against its documented behavior. Everything runs on the CPU backend the
+conftest forces; measured drift there is ~7e-4 so the 5e-3 budgets have
+~7x headroom without masking real regressions.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from video_features_tpu.config import (
+    ExtractionConfig,
+    enable_compile_cache,
+    sanity_check,
+)
+from video_features_tpu.ops.preprocess import (
+    CLIP_MEAN,
+    CLIP_STD,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    device_preprocess_frames,
+    normalize_chw,
+    pil_center_crop,
+    pil_resize,
+    to_float_chw,
+)
+from video_features_tpu.ops.resize import fused_resize_crop_banded
+from video_features_tpu.ops.window import pad_hw, spatial_bucket
+
+pytestmark = pytest.mark.quick
+
+RNG = np.random.RandomState(7)
+
+# the device chain replays PIL's inter-pass uint8 quantization, so the
+# residual is PIL's 8-bit fixed-point coefficient table: one uint8 step
+# per pixel, scaled into normalized space by the smallest std channel
+CLIP_PIXEL_TOL = 1.5 / 255.0 / min(CLIP_STD)
+IMAGENET_PIXEL_TOL = 2.5 / 255.0 / min(IMAGENET_STD)
+
+# e2e feature drift budget (measured max ~7e-4 on CPU with seed-0 init)
+E2E_DRIFT = 5e-3
+
+
+def _banded(h, w, resize_to, crop, method):
+    bh, bw = spatial_bucket(h, w)
+    wt_y, idx_y, wt_x, idx_x = fused_resize_crop_banded(
+        h, w, resize_to, crop, method, pad_h=bh, pad_w=bw
+    )
+    return (bh, bw), (wt_y, idx_y), (wt_x, idx_x)
+
+
+def _device_chain(img, resize_to, crop, method, mean, std):
+    """Exactly what the extractors dispatch: bucket-pad + banded taps."""
+    h, w = img.shape[:2]
+    (bh, bw), wy, wx = _banded(h, w, resize_to, crop, method)
+    out = device_preprocess_frames(
+        jnp.asarray(pad_hw(img[None], bh, bw)), wy, wx, mean, std
+    )
+    return np.asarray(out)[0]
+
+
+def _host_clip_chain(img, size=224):
+    from PIL import Image
+
+    x = pil_center_crop(pil_resize(img, size, interpolation=Image.BICUBIC), size)
+    return normalize_chw(to_float_chw(x), CLIP_MEAN, CLIP_STD)
+
+
+@pytest.mark.parametrize(
+    "hw", [(360, 640), (240, 426), (224, 224), (100, 640), (232, 420)]
+)
+def test_clip_chain_parity_vs_pil(hw):
+    img = RNG.randint(0, 256, (hw[0], hw[1], 3)).astype(np.uint8)
+    ref = _host_clip_chain(img)
+    got = _device_chain(img, 224, 224, "bicubic", CLIP_MEAN, CLIP_STD)
+    assert got.shape == ref.shape == (3, 224, 224)
+    assert np.abs(got - ref).max() <= CLIP_PIXEL_TOL
+
+
+def test_resnet_chain_parity_vs_pil():
+    img = RNG.randint(0, 256, (240, 320, 3)).astype(np.uint8)
+    resized = pil_resize(img, 256)  # host default: bilinear smaller-edge
+    ref = normalize_chw(
+        to_float_chw(pil_center_crop(resized, 224)), IMAGENET_MEAN, IMAGENET_STD
+    )
+    got = _device_chain(img, 256, 224, "bilinear", IMAGENET_MEAN, IMAGENET_STD)
+    assert np.abs(got - ref).max() <= IMAGENET_PIXEL_TOL
+
+
+def test_device_preprocess_batched_layouts_match_solo():
+    """Group (N,T,H,W,C) and row (R,H,W,C) einsum layouts must be
+    bit-identical to the solo (T,H,W,C) path."""
+    h, w = 120, 180
+    (bh, bw), wy, wx = _banded(h, w, 64, 56, "bicubic")
+    frames = RNG.randint(0, 256, (4, bh, bw, 3)).astype(np.uint8)
+    solo = np.asarray(
+        device_preprocess_frames(jnp.asarray(frames), wy, wx, CLIP_MEAN, CLIP_STD)
+    )
+    stack2 = lambda pair: tuple(np.stack([a, a]) for a in pair)
+    group = np.asarray(
+        device_preprocess_frames(
+            jnp.asarray(np.stack([frames, frames])),
+            stack2(wy), stack2(wx), CLIP_MEAN, CLIP_STD,
+        )
+    )
+    np.testing.assert_array_equal(group[0], solo)
+    np.testing.assert_array_equal(group[1], solo)
+    stack4 = lambda pair: tuple(np.stack([a] * 4) for a in pair)
+    rows = np.asarray(
+        device_preprocess_frames(
+            jnp.asarray(frames), stack4(wy), stack4(wx), CLIP_MEAN, CLIP_STD
+        )
+    )
+    np.testing.assert_array_equal(rows, solo)
+
+
+# --- end-to-end: uint8 ingest vs host path --------------------------------
+
+@pytest.fixture(scope="module")
+def mixed_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    root = tmp_path_factory.mktemp("devpre_media")
+    # two resolutions sharing the (256, 448) bucket + one other bucket
+    return [
+        synth_video(str(root / "a.mp4"), n_frames=24, width=426, height=240, seed=0),
+        synth_video(str(root / "b.mp4"), n_frames=32, width=420, height=232, seed=1),
+        synth_video(str(root / "c.mp4"), n_frames=28, width=320, height=240, seed=2),
+    ]
+
+
+def _clip_run(videos, tmp_path, preprocess, video_batch=1):
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type="CLIP-ViT-B/32",
+        video_paths=list(videos),
+        extract_method="uni_4",
+        preprocess=preprocess,
+        video_batch=video_batch,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+    )
+    return ExtractCLIP(cfg, external_call=True)()
+
+
+@pytest.fixture(scope="module")
+def clip_host_and_device(mixed_videos, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("devpre_clip")
+    return (
+        _clip_run(mixed_videos, tmp, "host"),
+        _clip_run(mixed_videos, tmp, "device"),
+    )
+
+
+def test_clip_uint8_e2e_drift_budget(clip_host_and_device):
+    """Acceptance: device-path CLIP features within the pinned drift budget
+    of the host path across mixed resolutions."""
+    host, dev = clip_host_and_device
+    assert len(host) == len(dev) == 3
+    for h, d in zip(host, dev):
+        assert d["CLIP-ViT-B/32"].shape == h["CLIP-ViT-B/32"].shape == (4, 512)
+        np.testing.assert_array_equal(d["timestamps_ms"], h["timestamps_ms"])
+        drift = np.abs(d["CLIP-ViT-B/32"] - h["CLIP-ViT-B/32"]).max()
+        assert drift <= E2E_DRIFT, f"device-vs-host drift {drift:.2e}"
+
+
+def test_clip_device_aggregation_matches_solo(
+    mixed_videos, clip_host_and_device, tmp_path
+):
+    """--video_batch with device preprocess: mixed resolutions split into
+    per-bucket agg groups; fused results must match solo device results."""
+    _, solo = clip_host_and_device
+    fused = _clip_run(mixed_videos, tmp_path, "device", video_batch=2)
+    for s, f in zip(solo, fused):
+        np.testing.assert_allclose(
+            f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+
+
+def _resnet_cfg(videos, tmp_path, **kw):
+    return ExtractionConfig(
+        allow_random_init=True,
+        feature_type="resnet18",
+        video_paths=list(videos),
+        batch_size=8,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+        **kw,
+    )
+
+
+def test_resnet_device_vs_host_drift(mixed_videos, tmp_path):
+    from video_features_tpu.models.resnet.extract_resnet import ExtractResNet
+
+    vids = mixed_videos[:2]
+    host = ExtractResNet(_resnet_cfg(vids, tmp_path), external_call=True)()
+    dev = ExtractResNet(
+        _resnet_cfg(vids, tmp_path, preprocess="device"), external_call=True
+    )()
+    for h, d in zip(host, dev):
+        assert d["resnet18"].shape == h["resnet18"].shape
+        assert np.abs(d["resnet18"] - h["resnet18"]).max() <= E2E_DRIFT
+
+
+def test_resnet_device_streaming_fallback_matches(mixed_videos, tmp_path, monkeypatch):
+    """Over the prefetch byte cap the device path falls back to streaming
+    decode; features must match the prepared device path."""
+    from video_features_tpu.models.resnet import extract_resnet as mod
+
+    vids = mixed_videos[:1]
+    prepared = mod.ExtractResNet(
+        _resnet_cfg(vids, tmp_path, preprocess="device"), external_call=True
+    )()
+    monkeypatch.setattr(mod.ExtractResNet, "PIPELINE_MAX_BYTES", 1)
+    streamed = mod.ExtractResNet(
+        _resnet_cfg(vids, tmp_path, preprocess="device"), external_call=True
+    )()
+    np.testing.assert_allclose(
+        streamed[0]["resnet18"], prepared[0]["resnet18"], atol=2e-5, rtol=1e-5
+    )
+
+
+# --- config surface -------------------------------------------------------
+
+def test_preprocess_flag_validation():
+    def cfg(**kw):
+        return ExtractionConfig(allow_random_init=True, cpu=True, **kw)
+
+    # accepted: CLIP / ResNet families
+    sanity_check(cfg(feature_type="resnet18", preprocess="device"))
+    sanity_check(
+        cfg(feature_type="CLIP-ViT-B/32", extract_method="uni_4", preprocess="device")
+    )
+    with pytest.raises(ValueError, match="preprocess"):
+        sanity_check(cfg(feature_type="resnet18", preprocess="nonsense"))
+    with pytest.raises(ValueError, match="preprocess"):
+        sanity_check(cfg(feature_type="i3d", preprocess="device"))
+    with pytest.raises(ValueError, match="mesh"):
+        sanity_check(
+            cfg(feature_type="resnet18", preprocess="device", sharding="mesh")
+        )
+    with pytest.raises(ValueError, match="spatial_bucket"):
+        sanity_check(cfg(feature_type="resnet18", spatial_bucket=0))
+
+
+def test_cli_preprocess_flags_parse():
+    from video_features_tpu.config import parse_args
+
+    cfg = parse_args(
+        [
+            "--feature_type", "resnet18",
+            "--video_paths", "x.mp4",
+            "--allow_random_init",
+            "--cpu",
+            "--preprocess", "device",
+            "--spatial_bucket", "32",
+            "--compile_cache", "/tmp/ccache",
+            "--compile_cache_min_s", "0.5",
+        ]
+    )
+    assert cfg.preprocess == "device"
+    assert cfg.spatial_bucket == 32
+    assert cfg.compile_cache == "/tmp/ccache"
+    assert cfg.compile_cache_min_s == 0.5
+
+
+def test_enable_compile_cache(tmp_path):
+    import jax
+
+    cache_dir = tmp_path / "jit_cache"
+    enable_compile_cache(
+        ExtractionConfig(compile_cache=str(cache_dir), compile_cache_min_s=0.25)
+    )
+    try:
+        assert cache_dir.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.25
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+    # disabled by default: no directory side effects
+    enable_compile_cache(ExtractionConfig())
+    assert jax.config.jax_compilation_cache_dir is None

@@ -17,7 +17,12 @@ from video_features_tpu.ops.preprocess import (
     scale_to_1_1,
     tensor_center_crop,
 )
-from video_features_tpu.ops.resize import resize_bilinear
+from video_features_tpu.ops.resize import (
+    fused_resize_crop_matrices,
+    resample_matrix,
+    resize_bilinear,
+    resized_hw,
+)
 from video_features_tpu.ops.sampler import bilinear_sampler, grid_sample
 
 # whole-module smoke tier (README 'Quick test tier')
@@ -219,3 +224,132 @@ def test_corr_auto_threshold_data_driven(tmp_path, monkeypatch):
     C._auto_threshold.cache_clear()
     assert C._auto_threshold() == 1024
     C._auto_threshold.cache_clear()
+
+
+# --- PIL-semantics resample matrices (--preprocess device) -----------------
+
+def _two_pass_quant(img: np.ndarray, wy: np.ndarray, wx: np.ndarray) -> np.ndarray:
+    """PIL's pass structure in numpy: horizontal first, round+clip to the
+    uint8 grid between passes and after (ops/preprocess.py::quant8)."""
+    def q8(v):
+        return np.clip(np.round(v), 0.0, 255.0)
+
+    y = q8(np.einsum("hwc,qw->hqc", img.astype(np.float64), wx))
+    return q8(np.einsum("hqc,ph->pqc", y, wy))
+
+
+@pytest.mark.parametrize("method,pil_filter", [
+    ("bicubic", "BICUBIC"), ("bilinear", "BILINEAR"),
+])
+@pytest.mark.parametrize("in_hw,out_hw", [
+    ((240, 426), (224, 398)),   # downsample
+    ((64, 48), (160, 120)),     # upsample (support stays at the kernel's)
+    ((100, 640), (224, 224)),   # mixed: upsample H, downsample W
+])
+def test_resample_matrix_matches_pil(method, pil_filter, in_hw, out_hw):
+    from PIL import Image
+
+    img = RNG.randint(0, 256, (in_hw[0], in_hw[1], 3)).astype(np.uint8)
+    ref = np.asarray(
+        Image.fromarray(img).resize(
+            (out_hw[1], out_hw[0]), getattr(Image, pil_filter)
+        )
+    ).astype(np.float64)
+    wy = resample_matrix(in_hw[0], out_hw[0], method)
+    wx = resample_matrix(in_hw[1], out_hw[1], method)
+    got = _two_pass_quant(img, wy, wx)
+    # residual vs PIL is its 8-bit fixed-point coefficient table: at most
+    # one uint8 step per quantized pass, even on worst-case random noise
+    assert np.abs(got - ref).max() <= 2.0
+    # taps always renormalize to a partition of unity
+    np.testing.assert_allclose(wy.sum(axis=1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(wx.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_resample_matrix_identity_at_scale_one():
+    for method in ("bicubic", "bilinear"):
+        np.testing.assert_array_equal(
+            resample_matrix(17, 17, method), np.eye(17, dtype=np.float32)
+        )
+
+
+def test_resized_hw_mirrors_pil_resize():
+    from PIL import Image
+
+    for h, w in [(360, 640), (240, 426), (224, 500), (100, 640), (224, 224)]:
+        img = RNG.randint(0, 256, (h, w, 3)).astype(np.uint8)
+        ref = pil_resize(img, 224, interpolation=Image.BICUBIC)
+        assert resized_hw(h, w, 224) == ref.shape[:2]
+
+
+def test_fused_matrices_bucket_padding_cannot_bleed():
+    """Columns past (h, w) carry zero weight: garbage in the spatial-bucket
+    pad region must not change the output by one ULP."""
+    h, w = 240, 426
+    wy0, wx0 = fused_resize_crop_matrices(h, w, 224, 224, "bicubic")
+    wyp, wxp = fused_resize_crop_matrices(
+        h, w, 224, 224, "bicubic", pad_h=256, pad_w=448
+    )
+    img = RNG.randint(0, 256, (h, w)).astype(np.float32)
+    padded = np.full((256, 448), 255.0, np.float32)  # worst-case garbage
+    padded[:h, :w] = img
+    np.testing.assert_array_equal(wy0 @ img @ wx0.T, wyp @ padded @ wxp.T)
+
+
+def test_fused_matrices_crop_pad_matches_pil_center_crop():
+    """Resized image SMALLER than the crop: pil_center_crop zero-pads with
+    floor-divided margins before cropping; the fused matrices must place
+    their zero rows/cols identically."""
+    from PIL import Image
+
+    h, w, resize_to, crop = 50, 40, 64, 96  # resized (80, 64) < 96
+    img = RNG.randint(0, 256, (h, w, 3)).astype(np.uint8)
+    oh, ow = resized_hw(h, w, resize_to)
+    assert oh < crop and ow < crop
+    resized = np.asarray(
+        Image.fromarray(img).resize((ow, oh), Image.BICUBIC)
+    )
+    ref = pil_center_crop(resized, crop).astype(np.float64)
+    wy, wx = fused_resize_crop_matrices(h, w, resize_to, crop, "bicubic")
+    got = _two_pass_quant(img, wy, wx)
+    assert np.abs(got - ref).max() <= 1.0
+
+
+def test_spatial_bucket_and_pad_hw():
+    from video_features_tpu.ops.window import pad_hw, spatial_bucket
+
+    assert spatial_bucket(240, 426) == (256, 448)
+    assert spatial_bucket(256, 448) == (256, 448)  # already on the grid
+    assert spatial_bucket(1, 1) == (64, 64)        # floor = multiple
+    assert spatial_bucket(100, 640, multiple=32) == (128, 640)
+    # explicit buckets: smallest (by area) that fits both axes
+    bk = [(720, 1280), (256, 448)]
+    assert spatial_bucket(240, 426, buckets=bk) == (256, 448)
+    assert spatial_bucket(300, 426, buckets=bk) == (720, 1280)
+    assert spatial_bucket(800, 1400, buckets=bk) == (832, 1408)  # fallback
+
+    x = RNG.randint(0, 256, (5, 240, 426, 3)).astype(np.uint8)
+    p = pad_hw(x, 256, 448)
+    assert p.shape == (5, 256, 448, 3)
+    np.testing.assert_array_equal(p[:, :240, :426], x)
+    assert p[:, 240:].sum() == 0 and p[:, :, 426:].sum() == 0
+    assert pad_hw(x, 240, 426) is x  # no-op fast path
+
+
+def test_banded_taps_reconstruct_dense_and_share_bucket_k():
+    from video_features_tpu.ops.resize import banded, fused_resize_crop_banded
+
+    wy, wx = fused_resize_crop_matrices(240, 426, 224, 224, "bicubic",
+                                        pad_h=256, pad_w=448)
+    for m in (wy, wx):
+        wt, idx = banded(m)
+        back = np.zeros_like(m)
+        for q in range(m.shape[0]):
+            for k in range(wt.shape[1]):
+                back[q, idx[q, k]] += wt[q, k]  # dup tail indices carry 0
+        np.testing.assert_array_equal(back, m)
+    # K is computed at the bucket corner: two resolutions sharing the
+    # (256, 448) bucket must produce stackable (same-K) tap arrays
+    a = fused_resize_crop_banded(240, 426, 224, 224, "bicubic", 256, 448)
+    b = fused_resize_crop_banded(232, 420, 224, 224, "bicubic", 256, 448)
+    assert a[0].shape == b[0].shape and a[2].shape == b[2].shape

@@ -10,7 +10,7 @@ scheduler — for both single- and multi-device runs.
 
 import sys
 
-from video_features_tpu.config import parse_args
+from video_features_tpu.config import enable_compile_cache, parse_args
 from video_features_tpu.extract.registry import build_extractor
 from video_features_tpu.parallel.devices import resolve_devices
 from video_features_tpu.parallel.scheduler import (
@@ -23,6 +23,9 @@ def main(argv=None) -> None:
     import os
 
     cfg = parse_args(argv)
+    # before any device/compile touch, so every executable (including the
+    # --preprocess device bucket grid) can hit/populate the on-disk cache
+    enable_compile_cache(cfg)
 
     # Multi-host slices: when a launcher provides a coordinator (e.g.
     # JAX_COORDINATOR_ADDRESS on a TPU pod), join the distributed runtime

@@ -175,6 +175,33 @@ class ExtractionConfig:
     #                reproduces its fps path bit-for-bit, including the
     #                resampled/re-compressed pixels (needs ffmpeg).
     fps_retarget: str = "nearest"
+    # Where the resize/crop/normalize chain runs for the image-model
+    # extractors (CLIP's bicubic chain, the ResNet family's bilinear
+    # chain):
+    #   'host'   — the reference-exact PIL chain (or --host_preprocess
+    #              native) on the decode threads; the parity default.
+    #   'device' — decode ships raw uint8 HWC frames (4x less H2D than
+    #              float32), padded to a spatial bucket grid
+    #              (ops/window.py::spatial_bucket), and one fused jit
+    #              program does PIL-semantics resize + center crop +
+    #              normalize + encoder forward (ops/preprocess.py::
+    #              device_preprocess_frames). Lifts the ~300 fps host
+    #              preprocess ceiling (BENCH_r05); within 1/255/pixel of
+    #              PIL (tests/test_device_preprocess.py).
+    preprocess: str = "host"
+    # --preprocess device: each spatial axis of a source resolution
+    # rounds up to the next multiple of this, so a variable-resolution
+    # corpus compiles O(buckets) executables instead of O(shapes).
+    # Bigger = fewer compiles, more padded-pixel compute.
+    spatial_bucket: int = 64
+    # Persistent XLA compilation cache directory: repeat runs skip
+    # cold-start compiles of the bucketed executables (and everything
+    # else). None = off (JAX's default in-memory cache only).
+    compile_cache: Optional[str] = None
+    # Only executables whose compile took at least this many seconds are
+    # written to --compile_cache (jax_persistent_cache_min_compile_time_
+    # secs) — keeps trivial compiles from churning the cache dir.
+    compile_cache_min_s: float = 1.0
     # 3D-conv lowering for the 3D-conv families, i3d + r21d
     # (common/layers.py::Conv3DCompat):
     #   'auto'       — honor the VFT_CONV3D_IMPL env var, else direct;
@@ -264,6 +291,27 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
             "utils.py:222-244); other extractors sample their own grids "
             f"(got {cfg.feature_type!r})"
         )
+    if cfg.preprocess not in ("host", "device"):
+        raise ValueError(f"unknown preprocess mode: {cfg.preprocess}")
+    if cfg.preprocess == "device":
+        if cfg.feature_type not in CLIP_FEATURE_TYPES + RESNET_FEATURE_TYPES:
+            raise ValueError(
+                "--preprocess device covers the image-model extractors "
+                "(CLIP family, resnet*) — the flow/3D-conv families keep "
+                f"their own device chains (got {cfg.feature_type!r})"
+            )
+        if cfg.sharding == "mesh":
+            raise ValueError(
+                "--preprocess device does not compose with --sharding "
+                "mesh yet (the raw-frame dispatch is not sharded; "
+                "ROADMAP open item)"
+            )
+    if cfg.spatial_bucket < 1:
+        raise ValueError(f"spatial_bucket must be >= 1, got {cfg.spatial_bucket}")
+    if cfg.compile_cache_min_s < 0:
+        raise ValueError(
+            f"compile_cache_min_s must be >= 0, got {cfg.compile_cache_min_s}"
+        )
     if cfg.mesh_context and cfg.attn != "fused":
         raise ValueError(
             "--mesh_context injects the ring-attention core; it cannot "
@@ -271,6 +319,24 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
             "blockwise per arriving shard)"
         )
     return cfg
+
+
+def enable_compile_cache(cfg: ExtractionConfig) -> None:
+    """Wire --compile_cache into JAX's persistent compilation cache.
+
+    Must run before the first device/compile touch (cli.py calls it right
+    after parse_args). Safe to call repeatedly — jax.config.update is
+    idempotent for equal values."""
+    if not cfg.compile_cache:
+        return
+    import jax
+
+    os.makedirs(cfg.compile_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cfg.compile_cache)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(cfg.compile_cache_min_s),
+    )
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -352,6 +418,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--video_batch", type=int, default=1,
                    help="aggregate up to N videos' prepared batches into "
                         "one device dispatch (CLIP/ResNet/R21D); 1 = off")
+    p.add_argument("--preprocess", default="host", choices=["host", "device"],
+                   help="where the resize/crop/normalize chain runs for "
+                        "CLIP/ResNet: 'host' (reference-exact PIL, the "
+                        "default) or 'device' (raw uint8 frames H2D, one "
+                        "fused jit does bicubic/bilinear resize + crop + "
+                        "normalize + encoder forward)")
+    p.add_argument("--spatial_bucket", type=int, default=64,
+                   help="--preprocess device: round each source-resolution "
+                        "axis up to a multiple of this before compiling "
+                        "(O(buckets) executables on mixed-resolution "
+                        "corpora, not O(shapes))")
+    p.add_argument("--compile_cache", type=str, default=None,
+                   help="persistent XLA compilation cache dir "
+                        "(jax_compilation_cache_dir): repeat runs skip "
+                        "cold-start compiles of the bucketed executables")
+    p.add_argument("--compile_cache_min_s", type=float, default=1.0,
+                   help="min compile seconds before an executable is "
+                        "written to --compile_cache")
     p.add_argument("--mesh_context", action="store_true",
                    help="context parallelism under --sharding mesh: shard "
                         "the transformer token axis over the mesh and run "

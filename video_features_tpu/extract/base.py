@@ -134,11 +134,13 @@ class BaseExtractor:
                     f"({native.build_error()}); using PIL"
                 )
             else:
-                # share host cores across concurrent device workers
+                # share the affinity-visible host cores across concurrent
+                # device workers (native._resolve_threads re-clamps, so a
+                # stale decision can never oversubscribe)
                 from video_features_tpu.parallel.devices import resolve_devices
 
                 n_workers = max(len(resolve_devices(self.config)), 1)
-                self._native_threads = max((os.cpu_count() or 1) // n_workers, 1)
+                self._native_threads = max(native.cpu_budget() // n_workers, 1)
         else:
             self._use_native = False
 
@@ -149,6 +151,13 @@ class BaseExtractor:
             if self._use_native is None:
                 self._decide_native()
         return bool(self._use_native)
+
+    def _device_preprocess_enabled(self) -> bool:
+        """--preprocess device: the image-model extractors (CLIP, ResNet)
+        ship raw uint8 frames and fuse resize/crop/normalize into the
+        encoder dispatch (ops/preprocess.py::device_preprocess_frames).
+        sanity_check restricts the flag to the extractors that honor it."""
+        return getattr(self.config, "preprocess", "host") == "device"
 
     # --- per-device model state -------------------------------------------
     def _build(self, device) -> Any:

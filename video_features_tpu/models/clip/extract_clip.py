@@ -33,12 +33,14 @@ from video_features_tpu.models.common.weights import (
 from video_features_tpu.ops.preprocess import (
     CLIP_MEAN,
     CLIP_STD,
+    device_preprocess_frames,
     normalize_chw,
     pil_center_crop,
     pil_resize,
     to_float_chw,
 )
-from video_features_tpu.ops.window import bucket_size, pad_batch
+from video_features_tpu.ops.resize import fused_resize_crop_banded
+from video_features_tpu.ops.window import bucket_size, pad_batch, pad_hw, spatial_bucket
 
 
 class ExtractCLIP(BaseExtractor):
@@ -155,8 +157,25 @@ class ExtractCLIP(BaseExtractor):
             def encode_image(p, x):
                 return model.apply({"params": p}, x)
 
-        return {"params": params, "encode_image": encode_image,
-                "device": device, "pad_data": not context}
+        state = {"params": params, "encode_image": encode_image,
+                 "device": device, "pad_data": not context}
+        if self._device_preprocess_enabled() and not is_mesh(device):
+            # --preprocess device: raw uint8 HWC frames + the per-video
+            # banded resize/crop taps enter as jit INPUTS, so one
+            # executable serves every source resolution in a spatial
+            # bucket. The fused program: resize+crop (two K-tap banded
+            # passes) -> normalize -> encoder forward, one dispatch.
+            @jax.jit
+            def encode_raw(p, x_u8, wy, wx):
+                x = device_preprocess_frames(
+                    x_u8, wy, wx, CLIP_MEAN, CLIP_STD, out_dtype=dt
+                )
+                if x.ndim == 5:  # fused --video_batch group: (N, T, ...)
+                    x = x.reshape((-1,) + x.shape[2:])
+                return model.apply({"params": p}, x)
+
+            state["encode_raw"] = encode_raw
+        return state
 
     def _preprocess(self, frame: np.ndarray) -> np.ndarray:
         size = self.model_cfg.image_size
@@ -186,6 +205,23 @@ class ExtractCLIP(BaseExtractor):
         frames, fps, timestamps_ms = extract_frames(
             video_path, self.config.extract_method, self.config.decoder
         )
+        if self._device_preprocess_enabled():
+            # raw uint8 HWC frames, padded (time bucket x spatial bucket);
+            # resize/crop/normalize happens inside encode_raw on-device.
+            # Payload slot 0 is the (frames, (wt_y, idx_y), (wt_x, idx_x))
+            # triple — the banded taps are lru_cached per source
+            # resolution, so a corpus pays the host tap construction once
+            # per (h, w).
+            arr = np.stack(frames)  # (T, H, W, 3) uint8
+            T, h, w = arr.shape[:3]
+            bh, bw = spatial_bucket(h, w, self.config.spatial_bucket)
+            size = self.model_cfg.image_size
+            wt_y, idx_y, wt_x, idx_x = fused_resize_crop_banded(
+                h, w, size, size, "bicubic", pad_h=bh, pad_w=bw
+            )
+            arr = pad_batch(arr, bucket_size(T, buckets=self.config.shape_buckets))
+            arr = pad_hw(arr, bh, bw)
+            return (arr, (wt_y, idx_y), (wt_x, idx_x)), T, fps, timestamps_ms
         batch = self._preprocess_frames(frames)  # (T, 3, H, W)
         T = batch.shape[0]
         padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
@@ -215,6 +251,10 @@ class ExtractCLIP(BaseExtractor):
 
     def dispatch_prepared(self, device, state, path_entry, payload):
         padded, T, fps, timestamps_ms = payload
+        if isinstance(padded, tuple):  # --preprocess device
+            x_u8, wy, wx = jax.device_put(padded, state["device"])
+            out = state["encode_raw"](state["params"], x_u8, wy, wx)
+            return out, T, fps, timestamps_ms
         x = self._place(state, padded)
         return state["encode_image"](state["params"], x), T, fps, timestamps_ms
 
@@ -236,13 +276,38 @@ class ExtractCLIP(BaseExtractor):
     AGG_MAX_FRAMES = 256
 
     def agg_key(self, payload):
-        if payload[0].shape[0] > self.AGG_MAX_FRAMES:
+        head = payload[0]
+        if isinstance(head, tuple):  # --preprocess device: bucketed uint8
+            if head[0].shape[0] > self.AGG_MAX_FRAMES:
+                return None
+            # the spatial bucket rides the key via the frame shape, so
+            # mixed-resolution videos fuse exactly when they share a
+            # (T_pad, bucket_h, bucket_w) executable
+            return ("dev", head[0].shape)
+        if head.shape[0] > self.AGG_MAX_FRAMES:
             return None
-        return payload[0].shape  # the bucketed (T_pad, 3, H, W) shape
+        return head.shape  # the bucketed (T_pad, 3, H, W) shape
 
     def dispatch_group(self, device, state, entries, payloads):
         group = max(int(self.config.video_batch or 1), 1)
-        bucket = payloads[0][0].shape[0]
+        head = payloads[0][0]
+        if isinstance(head, tuple):  # --preprocess device: per-video
+            # frames AND taps stack — each video keeps its own source
+            # resolution's taps inside the shared bucket executable (K is
+            # bucket-stable, so the tap arrays agree in shape)
+            bucket = head[0].shape[0]
+            xs = np.stack([p[0][0] for p in payloads])
+            wys = tuple(np.stack([p[0][1][j] for p in payloads]) for j in range(2))
+            wxs = tuple(np.stack([p[0][2][j] for p in payloads]) for j in range(2))
+            if len(payloads) < group:  # partial flush: keep the shape
+                xs = pad_batch(xs, group)
+                wys = tuple(pad_batch(a, group) for a in wys)
+                wxs = tuple(pad_batch(a, group) for a in wxs)
+            xs, wys, wxs = jax.device_put((xs, wys, wxs), state["device"])
+            out = state["encode_raw"](state["params"], xs, wys, wxs)
+            metas = [(i * bucket, p[1], p[2], p[3]) for i, p in enumerate(payloads)]
+            return out, metas
+        bucket = head.shape[0]
         x = np.concatenate([p[0] for p in payloads], axis=0)
         if len(payloads) < group:  # partial flush: keep the compiled shape
             x = pad_batch(x, group * bucket)

@@ -28,7 +28,14 @@ from video_features_tpu.io.video import stream_frames
 from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.models.resnet.convert import convert_state_dict
 from video_features_tpu.models.resnet.model import build, init_params
-from video_features_tpu.ops.preprocess import imagenet_preprocess
+from video_features_tpu.ops.preprocess import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    device_preprocess_frames,
+    imagenet_preprocess,
+)
+from video_features_tpu.ops.resize import fused_resize_crop_banded
+from video_features_tpu.ops.window import pad_batch, pad_hw, spatial_bucket
 from video_features_tpu.utils.labels import show_predictions_on_dataset
 
 
@@ -80,7 +87,34 @@ class ExtractResNet(BaseExtractor):
             return model.apply({"params": p}, x)
 
         forward = jit_sharded_forward(forward, device, n_out=2)
-        return {"params": params, "forward": forward, "device": device}
+        state = {"params": params, "forward": forward, "device": device}
+        if self._device_preprocess_enabled():
+            # --preprocess device (sanity_check already excludes mesh):
+            # raw uint8 frames + the video's banded resize/crop taps fuse
+            # the bilinear-256/crop-224/normalize chain into the forward
+            @jax.jit
+            def forward_raw(p, x_u8, wy, wx):
+                x = device_preprocess_frames(
+                    x_u8, wy, wx, IMAGENET_MEAN, IMAGENET_STD, out_dtype=dt
+                )
+                return model.apply({"params": p}, x)
+
+            # --video_batch: rows from different videos share a chunked
+            # forward; ids gather each row's own source-resolution taps
+            # from the stacked per-video matrices
+            @jax.jit
+            def forward_raw_group(p, x_u8, wy_vids, wx_vids, ids):
+                x = device_preprocess_frames(
+                    x_u8,
+                    tuple(a[ids] for a in wy_vids),
+                    tuple(a[ids] for a in wx_vids),
+                    IMAGENET_MEAN, IMAGENET_STD, out_dtype=dt,
+                )
+                return model.apply({"params": p}, x)
+
+            state["forward_raw"] = forward_raw
+            state["forward_raw_group"] = forward_raw_group
+        return state
 
     def _preprocess_batch(self, batch: List[np.ndarray]) -> np.ndarray:
         """raw uint8 HWC frames -> (n, 3, 224, 224) normalized float32.
@@ -112,7 +146,71 @@ class ExtractResNet(BaseExtractor):
 
     # host half: stream-decode + preprocess into padded static-shape
     # batches (runs on --decode_workers threads under the async pipeline)
+    def _device_geometry(self, h: int, w: int):
+        """(bucket_h, bucket_w, (wt_y, idx_y), (wt_x, idx_x)) for a source
+        resolution under --preprocess device: the ResNet chain's bilinear
+        Resize(256) + CenterCrop(224) as bucket-padded banded taps."""
+        bh, bw = spatial_bucket(h, w, self.config.spatial_bucket)
+        wt_y, idx_y, wt_x, idx_x = fused_resize_crop_banded(
+            h, w, 256, 224, "bilinear", pad_h=bh, pad_w=bw
+        )
+        return bh, bw, (wt_y, idx_y), (wt_x, idx_x)
+
+    def _prepare_device(self, path_entry):
+        """--preprocess device prepare: batches hold raw uint8 HWC frames
+        padded to the spatial bucket; resize/crop/normalize fuses into
+        forward_raw on-device. The prefetch cap is resolution-dependent
+        here — a resident frame costs bucket_h*bucket_w*3 uint8 bytes, not
+        the host path's fixed 224x224 float32 — so it is computed from the
+        first decoded frame."""
+        video_path = video_path_of(path_entry)
+        fps = self.config.extraction_fps
+        decode_path, sel_fps = self._fps_source(video_path)
+        batch: List[np.ndarray] = []
+        batches: List[np.ndarray] = []
+        counts: List[int] = []
+        timestamps_ms: List[float] = []
+        geom = None
+        max_frames = self.PIPELINE_MAX_FRAMES
+
+        def flush():
+            n = len(batch)
+            x = pad_hw(np.stack(batch), geom[0], geom[1])
+            if n < self.batch_size:
+                x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
+            batches.append(x)
+            counts.append(n)
+
+        n_frames = 0
+        for frame, ts in stream_frames(decode_path, sel_fps, self.config.decoder):
+            if geom is None:
+                geom = self._device_geometry(*frame.shape[:2])
+                max_frames = self._prefetch_frame_cap(
+                    self.PIPELINE_MAX_BYTES, geom[0] * geom[1] * 3, floor=64
+                )
+            n_frames += 1
+            if n_frames > max_frames:
+                return ("stream", (decode_path, sel_fps))
+            batch.append(frame)
+            timestamps_ms.append(ts)
+            if len(batch) == self.batch_size:
+                flush()
+                batch = []
+        if batch:
+            flush()
+        if not batches:
+            raise IOError(f"no frames decoded from {video_path}")
+        from video_features_tpu.io.video import probe
+
+        actual_fps = fps or probe(video_path, self.config.decoder).fps or 25.0
+        return (
+            "dev",
+            (batches, counts, actual_fps, timestamps_ms, geom[2], geom[3]),
+        )
+
     def prepare(self, path_entry):
+        if self._device_preprocess_enabled():
+            return self._prepare_device(path_entry)
         video_path = video_path_of(path_entry)
         fps = self.config.extraction_fps
         decode_path, sel_fps = self._fps_source(video_path)
@@ -166,12 +264,20 @@ class ExtractResNet(BaseExtractor):
             from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
             n = len(batch)
-            x = self._preprocess_batch(batch)
-            if n < self.batch_size:
-                x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
-            x = pad_batch_for(state["device"], x)
-            x = place_batch(x, state["device"])
-            feats, logits = state["forward"](state["params"], x)
+            if self._device_preprocess_enabled():
+                bh, bw, wy, wx = self._device_geometry(*batch[0].shape[:2])
+                x = pad_hw(np.stack(batch), bh, bw)
+                if n < self.batch_size:
+                    x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
+                x, wy, wx = jax.device_put((x, wy, wx), state["device"])
+                feats, logits = state["forward_raw"](state["params"], x, wy, wx)
+            else:
+                x = self._preprocess_batch(batch)
+                if n < self.batch_size:
+                    x = np.pad(x, [(0, self.batch_size - n)] + [(0, 0)] * 3)
+                x = pad_batch_for(state["device"], x)
+                x = place_batch(x, state["device"])
+                feats, logits = state["forward"](state["params"], x)
             feats_out.append(np.asarray(feats)[:n])
             if self.config.show_pred:
                 show_predictions_on_dataset(np.asarray(logits)[:n], "imagenet")
@@ -204,6 +310,17 @@ class ExtractResNet(BaseExtractor):
     def dispatch_prepared(self, device, state, path_entry, payload):
         if payload[0] == "stream":
             return ("done", self._extract_streaming(state, payload[1]))
+        if payload[0] == "dev":  # --preprocess device (never mesh)
+            batches, counts, actual_fps, timestamps_ms, wy, wx = payload[1]
+            wy_d, wx_d = jax.device_put((wy, wx), state["device"])
+            outs = []
+            for x, n in zip(batches, counts):
+                x = jax.device_put(x, state["device"])
+                feats, logits = state["forward_raw"](
+                    state["params"], x, wy_d, wx_d
+                )
+                outs.append((feats, logits if self.config.show_pred else None, n))
+            return "batched", outs, actual_fps, timestamps_ms
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
         batches, counts, actual_fps, timestamps_ms = payload
@@ -243,6 +360,14 @@ class ExtractResNet(BaseExtractor):
     def agg_key(self, payload):
         if payload[0] == "stream" or self.config.show_pred:
             return None
+        if payload[0] == "dev":
+            batches, counts = payload[1][0], payload[1][1]
+            if sum(counts) > self.AGG_MAX_FRAMES:
+                return None
+            # (batch_size, bucket_h, bucket_w, 3): same-bucket videos fuse
+            # even at different source resolutions — each keeps its own
+            # taps via the per-video matrix stack in dispatch_group
+            return ("dev", batches[0].shape)
         batches, counts, _, _ = payload
         if sum(counts) > self.AGG_MAX_FRAMES:
             return None
@@ -250,12 +375,53 @@ class ExtractResNet(BaseExtractor):
 
     def dispatch_group(self, device, state, entries, payloads):
         group = max(int(self.config.video_batch or 1), 1)
+        if payloads[0][0] == "dev":
+            return self._dispatch_group_device(state, payloads, group)
         rows, totals = [], []
         for batches, counts, _, _ in payloads:
             rows.extend(x[:n] for x, n in zip(batches, counts))
             totals.append(sum(counts))
         outs = self._dispatch_rows_grouped(state, rows, self.batch_size * group)
         return outs, totals, [(p[2], p[3]) for p in payloads]
+
+    def _dispatch_group_device(self, state, payloads, group):
+        """Device-preprocess aggregation: the videos' valid uint8 rows
+        concatenate and re-chunk like the host path, but each row carries
+        a video id so forward_raw_group gathers that row's own
+        source-resolution taps from the (group,)-stacked tap arrays —
+        mixed resolutions inside one bucket share one compiled executable
+        (K is bucket-stable, so the stacks agree in shape)."""
+        rows, ids, totals, wys, wxs = [], [], [], [], []
+        for i, (_, (batches, counts, _, _, wy, wx)) in enumerate(payloads):
+            wys.append(wy)
+            wxs.append(wx)
+            for x, n in zip(batches, counts):
+                rows.append(x[:n])
+                ids.append(np.full(n, i, np.int32))
+            totals.append(sum(counts))
+        # partial flush keeps the compiled (group, ...) tap-stack shape
+        wy_vids = tuple(
+            pad_batch(np.stack([t[j] for t in wys]), group) for j in range(2)
+        )
+        wx_vids = tuple(
+            pad_batch(np.stack([t[j] for t in wxs]), group) for j in range(2)
+        )
+        all_rows = np.concatenate(rows, axis=0)
+        all_ids = np.concatenate(ids, axis=0)
+        chunk = self.batch_size * group
+        wy_d, wx_d = jax.device_put((wy_vids, wx_vids), state["device"])
+        outs = []
+        for i in range(0, all_rows.shape[0], chunk):
+            piece = all_rows[i : i + chunk]
+            n = piece.shape[0]
+            x = pad_batch(piece, chunk)
+            pid = pad_batch(all_ids[i : i + chunk], chunk)
+            x, pid = jax.device_put((x, pid), state["device"])
+            feats, _ = state["forward_raw_group"](
+                state["params"], x, wy_d, wx_d, pid
+            )
+            outs.append((feats, n))
+        return outs, totals, [(p[1][2], p[1][3]) for p in payloads]
 
     def fetch_group(self, handle):
         outs, totals, metas = handle

@@ -92,6 +92,31 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+def cpu_budget() -> int:
+    """Cores this process may actually run on: the scheduler affinity
+    mask when available (containers often pin it below os.cpu_count()),
+    else os.cpu_count()."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):  # non-Linux
+        return max(os.cpu_count() or 1, 1)
+
+
+def _resolve_threads(threads: int) -> int:
+    """Worker count for the C++ batch chains. ctypes already drops the
+    GIL for the whole call and preprocess.cpp fans the frame batch out
+    over std::thread — so extra threads only help while spare cores
+    exist. BENCH_r05 measured 2/4 requested threads SLOWER than 1 on a
+    1-core host (259/254 vs 260 fps): pure context-switch overhead. The
+    knob was dead weight there, so every request — including explicit
+    ones — clamps to the affinity-visible core count; <=0 keeps the
+    auto default (all cores, capped at 16)."""
+    budget = cpu_budget()
+    if threads <= 0:
+        return min(budget, 16)
+    return min(threads, budget)
+
+
 def available() -> bool:
     return _load() is not None
 
@@ -123,8 +148,7 @@ def imagenet_preprocess_batch(
     out = np.empty((n, 3, crop, crop), np.float32)
     mean_a = np.ascontiguousarray(mean, np.float32)
     std_a = np.ascontiguousarray(std, np.float32)
-    if threads <= 0:
-        threads = min(max(os.cpu_count() or 1, 1), 16)
+    threads = _resolve_threads(threads)
     lib.imagenet_preprocess_batch(
         frames.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n, h, w, resize_to, crop,
@@ -158,8 +182,7 @@ def clip_preprocess_batch(
     out = np.empty((n, 3, size, size), np.float32)
     mean_a = np.ascontiguousarray(mean, np.float32)
     std_a = np.ascontiguousarray(std, np.float32)
-    if threads <= 0:
-        threads = min(max(os.cpu_count() or 1, 1), 16)
+    threads = _resolve_threads(threads)
     lib.clip_preprocess_batch(
         frames.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n, h, w, size,

@@ -101,6 +101,91 @@ def imagenet_preprocess(
 
 # --- device side (jnp) ----------------------------------------------------
 
+def _banded_resample(x, wt, idx, axis: int):
+    """One separable resample pass as a K-tap banded accumulation:
+    ``sum_k take(x, idx[..., k], axis) * wt[..., k]``. K is static (band
+    width of the bucket corner, ops/resize.py::fused_resize_crop_banded),
+    so the python loop unrolls into one XLA fusion; the uint8 gathers
+    convert to float inside the fused multiply-add, never materializing
+    the full-resolution frames as float32. This is also PIL's own
+    accumulation order (ascending tap index), which is what keeps the
+    ≤1/255 parity that a dense-matmul reduction order loses."""
+    shared = wt.ndim == 2  # one tap set for the whole stack (solo layout)
+    y = 0.0
+    for k in range(wt.shape[-1]):
+        if shared:
+            g = jnp.take(x, idx[:, k], axis=axis)
+            bshape = [1] * x.ndim
+            bshape[axis] = -1
+        else:
+            # leading axis of wt/idx is the stack axis (N videos / R rows):
+            # (N, out) broadcasts to (N, 1, ..., out, ..., 1) against x
+            bshape = [1] * x.ndim
+            bshape[0] = idx.shape[0]
+            bshape[axis] = idx.shape[1]
+            g = jnp.take_along_axis(x, idx[:, :, k].reshape(bshape), axis=axis)
+        w = wt[..., k].reshape(bshape)
+        y = y + g.astype(jnp.float32) * w
+    return y
+
+
+def device_preprocess_frames(
+    frames: jnp.ndarray,
+    wy: Tuple[jnp.ndarray, jnp.ndarray],
+    wx: Tuple[jnp.ndarray, jnp.ndarray],
+    mean: Sequence[float],
+    std: Sequence[float],
+    out_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """The fused on-chip half of ``--preprocess device``: raw uint8 HWC
+    frames (padded to a spatial bucket) -> resize+crop (two banded
+    separable passes against the host-built PIL-semantics taps, see
+    ops/resize.py::fused_resize_crop_banded) -> /255 -> mean/std
+    normalize -> CHW in the compute dtype. One XLA fusion, no host
+    float32 blow-up, 4x less H2D than shipping preprocessed floats.
+
+    ``wy``/``wx`` are (weights, indices) pairs: K-tap bands instead of
+    dense matrices, so each output pixel pays ~K multiply-adds rather
+    than the full bucket-padded axis — the difference between the device
+    path beating the host PIL chain on a bare CPU core and losing to it
+    (dense matmuls are only free where an MXU does them).
+
+    PIL runs the two separable passes horizontal-first and rounds+clips
+    to uint8 between them and after the last one — with bicubic's
+    negative lobes the clipping is visible wherever the overshoot hits 0
+    or 255, so parity requires quantizing exactly where PIL does (the
+    same lesson native/preprocess.cpp::quant8 encodes). The residual vs
+    PIL is its 8-bit fixed-point coefficient table, ~1/255 per pixel
+    (tolerance-pinned in tests/test_ops.py).
+
+    Three tap layouts, matching the extractor dispatch shapes:
+      frames (T, H, W, C)    + wt (P, K)    -> (T, C, P, Q)   solo video
+      frames (N, T, H, W, C) + wt (N, P, K) -> (N, T, C, P, Q) per-video
+        taps for a fused --video_batch group (mixed resolutions in one
+        bucket)
+      frames (R, H, W, C)    + wt (R, P, K) -> (R, C, P, Q)   per-row
+        taps (rows from different videos concatenated, ResNet
+        aggregation)
+    """
+    wt_y, idx_y = wy
+    wt_x, idx_x = wx
+
+    def quant8(v):  # PIL's inter-pass uint8 round+clamp, kept as float
+        return jnp.clip(jnp.round(v), 0.0, 255.0)
+
+    # horizontal first (W axis), then vertical (H axis) — PIL's order
+    w_axis = frames.ndim - 2
+    y = quant8(_banded_resample(frames, wt_x, idx_x, axis=w_axis))
+    y = quant8(_banded_resample(y, wt_y, idx_y, axis=w_axis - 1))
+    # (..., P, Q, C) -> (..., C, P, Q)
+    perm = tuple(range(y.ndim - 3)) + (y.ndim - 1, y.ndim - 3, y.ndim - 2)
+    y = jnp.transpose(y, perm)
+    mean_a = jnp.asarray(mean, jnp.float32).reshape(-1, 1, 1)
+    std_a = jnp.asarray(std, jnp.float32).reshape(-1, 1, 1)
+    y = (y / 255.0 - mean_a) / std_a
+    return y.astype(out_dtype)
+
+
 def tensor_center_crop(x: jnp.ndarray, crop: int) -> jnp.ndarray:
     """Center crop on the trailing (H, W) axes (ref transforms.py:7-18)."""
     H, W = x.shape[-2], x.shape[-1]

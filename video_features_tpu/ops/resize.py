@@ -1,15 +1,52 @@
-"""Device-side bilinear resize with torch ``F.interpolate`` semantics.
+"""Device-side resize.
 
-Needed because the flow nets bake resizes into their forward passes with
-*both* corner conventions: RAFT's ``upflow8`` uses ``align_corners=True``
-(ref raft_src/utils/utils.py:89-91); PWC resizes inputs to /64 multiples
-and upsamples flow with the default ``align_corners=False`` (ref
-pwc_src/pwc_net.py:241-261). ``jax.image.resize('linear')`` only matches
-the half-pixel (False) convention, so both are implemented here on the
-shared gather machinery.
+Two families live here:
+
+- ``resize_bilinear`` — torch ``F.interpolate`` semantics, needed because
+  the flow nets bake resizes into their forward passes with *both* corner
+  conventions: RAFT's ``upflow8`` uses ``align_corners=True``
+  (ref raft_src/utils/utils.py:89-91); PWC resizes inputs to /64 multiples
+  and upsamples flow with the default ``align_corners=False`` (ref
+  pwc_src/pwc_net.py:241-261). ``jax.image.resize('linear')`` only matches
+  the half-pixel (False) convention, so both are implemented on the shared
+  gather machinery.
+
+- the PIL-semantics resamplers (``resample_matrix`` / ``resize_bicubic`` /
+  ``fused_resize_crop_matrices`` / ``fused_resize_crop_banded``) — the
+  device half of ``--preprocess device``. PIL's convolution resample
+  (what torchvision's Resize bottoms out in, and what the pip ``clip``
+  package's bicubic preprocess uses) is an antialiased separable filter:
+  half-pixel centers, support scaled by the downsampling ratio, taps
+  truncated at the image edge and renormalized. For a fixed (in, out)
+  size pair the taps are a constant dense (out, in) matrix, and a center
+  crop composes into the SAME matrix by building only the output
+  rows/cols inside the crop window. What actually ships to the device is
+  the matrix's banded form — per-output-pixel (weights, indices) of the
+  ~K contiguous nonzero taps — because the dense matmul pays the whole
+  bucket-padded axis per output pixel where PIL pays K: free on an MXU,
+  a ~50x FLOP tax on a CPU core. The taps are computed on the host per
+  source resolution and shipped as jit *inputs*, with K fixed per bucket
+  (ops/window.py::spatial_bucket), so one executable serves every source
+  resolution within a bucket: padded columns simply carry zero weight,
+  which is the per-bucket valid-region masking — pad pixels can never
+  bleed into the resize.
+
+  PIL rounds+clips to uint8 between the horizontal and vertical passes
+  and after the last one — load-bearing under bicubic overshoot, so the
+  fused device chain (ops/preprocess.py::device_preprocess_frames)
+  replays that quantization between its two passes, and accumulates taps
+  in PIL's own ascending-index order. The residual vs PIL is PIL's 8-bit
+  fixed-point coefficient table, ~1/255 per pixel (tolerance-pinned in
+  tests/test_ops.py).
 """
 
 from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import numpy as np
 
 import jax.numpy as jnp
 
@@ -50,3 +87,208 @@ def resize_bilinear(
     x = _lerp_axis(x, H, x.ndim - 2, align_corners)
     x = _lerp_axis(x, W, x.ndim - 1, align_corners)
     return x
+
+
+# --- PIL-semantics resample matrices (--preprocess device) -----------------
+
+def _pil_filter_weight(method: str, x: float) -> float:
+    """PIL filter kernels: 'bilinear' = triangle (support 1), 'bicubic' =
+    Keys cubic a=-0.5 (support 2) — the two kernels the reference's
+    preprocess chains use (torchvision Resize / pip-clip preprocess)."""
+    x = abs(x)
+    if method == "bicubic":
+        a = -0.5
+        if x < 1.0:
+            return ((a + 2.0) * x - (a + 3.0)) * x * x + 1.0
+        if x < 2.0:
+            return (((x - 5.0) * x + 8.0) * x - 4.0) * a
+        return 0.0
+    return 1.0 - x if x < 1.0 else 0.0
+
+
+_SUPPORT = {"bilinear": 1.0, "bicubic": 2.0}
+
+
+def resample_matrix(
+    in_size: int, out_size: int, method: str = "bicubic"
+) -> np.ndarray:
+    """Dense (out_size, in_size) float32 matrix of PIL's antialiased
+    convolution resample along one axis: half-pixel centers, support
+    scaled by the downsampling ratio, edge taps truncated + renormalized.
+    ``matrix @ column`` == PIL's per-axis pass (minus its intermediate
+    uint8 quantization). At scale 1 the interpolating kernels reduce to
+    the identity."""
+    if method not in _SUPPORT:
+        raise ValueError(f"unknown resample method: {method!r}")
+    scale = in_size / out_size
+    fscale = max(scale, 1.0)
+    support = _SUPPORT[method] * fscale
+    m = np.zeros((out_size, in_size), np.float64)
+    for i in range(out_size):
+        center = (i + 0.5) * scale
+        lo = max(int(math.floor(center - support + 0.5)), 0)
+        hi = min(int(math.floor(center + support + 0.5)), in_size)
+        w = np.array(
+            [_pil_filter_weight(method, (j + 0.5 - center) / fscale)
+             for j in range(lo, hi)],
+            np.float64,
+        )
+        total = w.sum()
+        if total != 0.0:
+            w /= total
+        m[i, lo:hi] = w
+    return m.astype(np.float32)
+
+
+def resize_pil(
+    x: jnp.ndarray, size: Tuple[int, int], method: str = "bicubic"
+) -> jnp.ndarray:
+    """Resize the trailing (H, W) axes of ``x`` with PIL's antialiased
+    half-pixel semantics (``Image.resize``). Matrices enter the graph as
+    constants — fine for a handful of shapes; the extractor fast path
+    passes them as inputs instead (``fused_resize_crop_matrices``)."""
+    H, W = int(size[0]), int(size[1])
+    wy = jnp.asarray(resample_matrix(x.shape[-2], H, method))
+    wx = jnp.asarray(resample_matrix(x.shape[-1], W, method))
+    # (..., H, W): contract H with wy, W with wx, in float32
+    y = jnp.einsum(
+        "ph,qw,...hw->...pq", wy, wx, x.astype(jnp.float32),
+        precision="highest",
+    )
+    return y
+
+
+def resize_bicubic(x: jnp.ndarray, size) -> jnp.ndarray:
+    """Device bicubic resize of the trailing (H, W) axes with
+    PIL/torchvision (antialiased, half-pixel) semantics."""
+    return resize_pil(x, size, method="bicubic")
+
+
+def resized_hw(h: int, w: int, size: int) -> Tuple[int, int]:
+    """The (oh, ow) PIL's smaller-edge resize produces, mirroring
+    ops/preprocess.py::pil_resize exactly — including the early return
+    when the smaller edge already equals ``size`` (no resize at all, even
+    if the larger edge differs)."""
+    if (w <= h and w == size) or (h <= w and h == size):
+        return h, w
+    if w < h:
+        return int(size * h / w), size
+    return size, int(size * w / h)
+
+
+@lru_cache(maxsize=128)
+def fused_resize_crop_matrices(
+    h: int,
+    w: int,
+    resize_to: int,
+    crop: int,
+    method: str = "bicubic",
+    pad_h: Optional[int] = None,
+    pad_w: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(Wy (crop, pad_h or h), Wx (crop, pad_w or w)) float32 matrices
+    composing PIL smaller-edge resize to ``resize_to`` with torchvision
+    CenterCrop(``crop``) — the whole spatial half of the CLIP/ResNet
+    preprocess chains as two matmuls: ``out = Wy @ frame @ Wx.T``.
+
+    Crop rows/cols outside the resized image carry zero weight (matching
+    ``pil_center_crop``'s zero padding), and source columns beyond
+    (h, w) — the ``spatial_bucket`` padding — carry zero weight too, so
+    bucket pad pixels cannot bleed into the output. Cached per source
+    resolution: a corpus re-uses each (h, w)'s matrices across videos."""
+    oh, ow = resized_hw(h, w, resize_to)
+    ry = resample_matrix(h, oh, method)
+    rx = resample_matrix(w, ow, method)
+    # torchvision CenterCrop offsets (round half to even); when the
+    # resized image is smaller than the crop, pil_center_crop zero-pads
+    # with a floor-divided top/left margin BEFORE cropping — mirror that
+    # as a negative offset so the zero rows land where PIL's pad does
+    def _offset(size_: int) -> int:
+        if size_ < crop:
+            return -((crop - size_) // 2)
+        return int(round((size_ - crop) / 2.0))
+
+    top = _offset(oh)
+    left = _offset(ow)
+    wy = np.zeros((crop, pad_h or h), np.float32)
+    wx = np.zeros((crop, pad_w or w), np.float32)
+    for out_r in range(crop):
+        r = top + out_r
+        if 0 <= r < oh:
+            wy[out_r, :h] = ry[r]
+    for out_c in range(crop):
+        c = left + out_c
+        if 0 <= c < ow:
+            wx[out_c, :w] = rx[c]
+    wy.setflags(write=False)
+    wx.setflags(write=False)
+    return wy, wx
+
+
+def banded(matrix: np.ndarray, k: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Compress a resample matrix to banded form: (weights (out, K),
+    indices (out, K)) with K the widest row band (PIL taps are contiguous,
+    so per-row nonzeros always fit one band). Rows narrower than K repeat
+    their last index under zero weight; all-zero rows (crop padding) point
+    at column 0 under zero weight. Dense matmul over a bucket-padded axis
+    pays the full axis length per output pixel where PIL's separable loop
+    pays ~2*support*scale taps — on the MXU that's free, on a CPU core
+    it's a ~50x FLOP tax, so the extractors ship THIS form and
+    ops/preprocess.py::device_preprocess_frames accumulates the K gathered
+    slices instead (also PIL's own tap order, keeping the ≤1/255 parity)."""
+    widths = (matrix != 0).sum(axis=1)
+    k_actual = int(widths.max()) if matrix.size else 0
+    k = max(k or 0, k_actual, 1)
+    wt = np.zeros((matrix.shape[0], k), np.float32)
+    idx = np.zeros((matrix.shape[0], k), np.int32)
+    for q, row in enumerate(matrix):
+        nz = np.nonzero(row)[0]
+        if len(nz):
+            n = len(nz)
+            idx[q, :n] = nz
+            idx[q, n:] = nz[-1]
+            wt[q, :n] = row[nz]
+    wt.setflags(write=False)
+    idx.setflags(write=False)
+    return wt, idx
+
+
+@lru_cache(maxsize=128)
+def fused_resize_crop_banded(
+    h: int,
+    w: int,
+    resize_to: int,
+    crop: int,
+    method: str = "bicubic",
+    pad_h: Optional[int] = None,
+    pad_w: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """``fused_resize_crop_matrices`` in banded form: (wt_y, idx_y, wt_x,
+    idx_x). K is computed at the BUCKET resolution (pad_h, pad_w), not the
+    source (h, w): band width grows with the resample scale, and the scale
+    (min-edge/resize_to) is maximal at the bucket corner, so every source
+    resolution sharing a bucket pads up to one static K — mixed-resolution
+    ``--video_batch`` groups can stack their taps, and one executable
+    serves the whole bucket."""
+    wy, wx = fused_resize_crop_matrices(h, w, resize_to, crop, method, pad_h, pad_w)
+    bh, bw = pad_h or h, pad_w or w
+    # analytic K bound from the bucket's worst-case scale: a resample row
+    # holds hi-lo taps with hi-lo <= floor(2*support*fscale)+1, and within
+    # a bucket fscale (= min-edge/resize_to when downsampling, 1 when
+    # upsampling) is maximal at the bucket corner. +1 absorbs resized_hw's
+    # int() rounding nudging a member's scale past the corner's. Derived
+    # from the bucket alone — NOT the source — so every resolution in a
+    # bucket pads to one K and their tap arrays stack for --video_batch.
+    # (The corner's own matrices can't serve as the bound: a corner whose
+    # min-edge lands exactly on resize_to takes pil_resize's no-op early
+    # return, K=1, while its neighbors still resize.)
+    smax = max(min(bh, bw) / float(resize_to), 1.0)
+    k = int(2 * _SUPPORT[method] * smax) + 2
+    wt_y, idx_y = banded(wy, k)
+    wt_x, idx_x = banded(wx, k)
+    if wt_y.shape[1] != k or wt_x.shape[1] != k:
+        raise AssertionError(
+            f"band width escaped its bucket bound: {wt_y.shape[1]}/"
+            f"{wt_x.shape[1]} vs {k} for {(h, w)} in {(bh, bw)}"
+        )
+    return wt_y, idx_y, wt_x, idx_x

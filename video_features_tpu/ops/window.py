@@ -8,7 +8,7 @@ cheaper than a recompile per length.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -28,4 +28,34 @@ def pad_batch(x: np.ndarray, to: int) -> np.ndarray:
     if x.shape[0] == to:
         return x
     pad = [(0, to - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad)
+
+
+def spatial_bucket(
+    h: int, w: int, multiple: int = 64,
+    buckets: Optional[Sequence[Tuple[int, int]]] = None,
+) -> Tuple[int, int]:
+    """The time-axis bucketing above, extended to H x W: the padded
+    (bucket_h, bucket_w) a raw-resolution frame rounds up to under
+    ``--preprocess device``. Each axis rounds up independently to the
+    next ``multiple`` (floor ``multiple``), so a variable-resolution
+    corpus compiles O(distinct buckets) executables instead of
+    O(distinct shapes); explicit ``buckets`` — (h, w) pairs — pick the
+    smallest that fits both axes instead. The pad region carries zero
+    resize weight (ops/resize.py::fused_resize_crop_matrices), so
+    bucketing never changes the output, only the compiled shape."""
+    if buckets:
+        for bh, bw in sorted(buckets, key=lambda b: b[0] * b[1]):
+            if h <= bh and w <= bw:
+                return int(bh), int(bw)
+    return bucket_size(h, multiple), bucket_size(w, multiple)
+
+
+def pad_hw(x: np.ndarray, to_h: int, to_w: int) -> np.ndarray:
+    """Zero-pad the (H, W) axes of (..., H, W, C) frames up to the
+    spatial bucket (the uint8-HWC layout the decode path produces)."""
+    h, w = x.shape[-3], x.shape[-2]
+    if h == to_h and w == to_w:
+        return x
+    pad = [(0, 0)] * (x.ndim - 3) + [(0, to_h - h), (0, to_w - w), (0, 0)]
     return np.pad(x, pad)
