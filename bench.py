@@ -83,6 +83,43 @@ I3D_STACK_BATCH = 2
 # both north-star synth workloads, shared by main() and the --sub parts
 CLIP_SPEC = dict(n_frames=120, width=640, height=360)
 I3D_SPEC = dict(n_frames=140, width=256, height=256)
+# standalone-flow workload: small enough that the RAFT recurrence doesn't
+# dominate the child's timeout on CPU smokes, big enough that the /8
+# padder grid (240, 320) -> (240, 320) is a real shape
+FLOW_SPEC = dict(n_frames=24, width=320, height=240)
+# clip_mixed corpus: (h, w) pairs chosen so each input bucket holds TWO
+# distinct source resolutions: (360,640)/(352,620) -> (384,640);
+# (240,426)/(232,420) -> (256,448)
+MIXED_SPECS = [(360, 640), (352, 620), (240, 426), (232, 420)] * 2
+
+
+def _device_contract_ids() -> dict:
+    """The device-preprocess output contracts the bench workloads land
+    on, plus the input-bucket histogram of the mixed corpus — how many
+    executables each workload compiles (recorded so a bucket-geometry
+    change shows up in the artifact, not just in the timings)."""
+    from collections import Counter
+
+    from video_features_tpu.models.pwc.model import internal_grid
+    from video_features_tpu.models.raft.model import input_grid
+    from video_features_tpu.ops.resize import resized_hw
+    from video_features_tpu.ops.window import flow_output_bucket, spatial_bucket
+
+    ih, iw = I3D_SPEC["height"], I3D_SPEC["width"]
+    fh, fw = FLOW_SPEC["height"], FLOW_SPEC["width"]
+    oh, ow = resized_hw(ih, iw, 256)
+    return {
+        "i3d_flow_output_bucket": list(flow_output_bucket(oh, ow)),
+        "flow_raft_padder_grid": list(input_grid(fh, fw)),
+        "flow_pwc_internal_grid": list(internal_grid(fh, fw)),
+        "mixed_input_bucket_histogram": dict(
+            sorted(
+                Counter(
+                    str(spatial_bucket(h, w)) for h, w in MIXED_SPECS
+                ).items()
+            )
+        ),
+    }
 
 
 def _pass_stats(n_items: int, times: list) -> dict:
@@ -147,7 +184,9 @@ def bench_clip(
     return _pass_stats(n_videos, times)
 
 
-def bench_i3d_raft(video: str, tmp: str, flow_type: str = "raft") -> float:
+def bench_i3d_raft(
+    video: str, tmp: str, flow_type: str = "raft", preprocess: str = "host"
+) -> float:
     from video_features_tpu.config import ExtractionConfig
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.parallel.devices import resolve_devices
@@ -160,8 +199,9 @@ def bench_i3d_raft(video: str, tmp: str, flow_type: str = "raft") -> float:
         # --batch_size 2: both of the video's 64-frame stacks fuse into
         # one RAFT+I3D dispatch (models/i3d stack batching)
         batch_size=I3D_STACK_BATCH,
-        tmp_path=os.path.join(tmp, "t" + flow_type),
-        output_path=os.path.join(tmp, "o" + flow_type),
+        preprocess=preprocess,
+        tmp_path=os.path.join(tmp, "t" + flow_type + preprocess),
+        output_path=os.path.join(tmp, "o" + flow_type + preprocess),
     )
     ex = ExtractI3D(cfg, external_call=True)
     ex.progress.disable = True
@@ -173,6 +213,40 @@ def bench_i3d_raft(video: str, tmp: str, flow_type: str = "raft") -> float:
         (r,) = ex([0], device=device)
         times.append(time.perf_counter() - t0)
     assert r["rgb"].shape[1] == 1024 and r["flow"].shape[1] == 1024
+    return _pass_stats(1, times)
+
+
+def bench_flow(
+    video: str, tmp: str, flow_type: str = "raft", preprocess: str = "host"
+) -> dict:
+    """Standalone flow extraction (RAFT/PWC pair streaming) — the
+    --preprocess device comparison rides the InputPadder-grid /
+    exact-shape contracts (models/common/flow_extract.py)."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+    from video_features_tpu.parallel.devices import resolve_devices
+
+    cls = ExtractRAFT if flow_type == "raft" else ExtractPWC
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type=flow_type,
+        video_paths=[video],
+        batch_size=8,
+        preprocess=preprocess,
+        tmp_path=os.path.join(tmp, "ft" + flow_type + preprocess),
+        output_path=os.path.join(tmp, "fo" + flow_type + preprocess),
+    )
+    ex = cls(cfg, external_call=True)
+    ex.progress.disable = True
+    device = resolve_devices(cfg)[0]
+    ex([0], device=device)  # warmup compile
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        (r,) = ex([0], device=device)
+        times.append(time.perf_counter() - t0)
+    assert r[flow_type].shape[0] == FLOW_SPEC["n_frames"] - 1
     return _pass_stats(1, times)
 
 
@@ -662,9 +736,7 @@ def _sub_clip_mixed() -> dict:
     actually delivers on a heterogeneous corpus, host vs device."""
     from video_features_tpu.utils.synth import synth_video
 
-    # (h, w) pairs chosen so each bucket holds TWO distinct resolutions:
-    # (360,640)/(352,620) -> (384,640); (240,426)/(232,420) -> (256,448)
-    specs = [(360, 640), (352, 620), (240, 426), (232, 420)] * 2
+    specs = MIXED_SPECS
     with tempfile.TemporaryDirectory() as tmp:
         videos = [
             synth_video(os.path.join(tmp, f"m{i}.mp4"), n_frames=60,
@@ -771,6 +843,8 @@ def _sub_conv3d_direct_probe() -> dict:
 
 
 def _sub_i3d_e2e() -> dict:
+    import jax
+
     from video_features_tpu.utils.synth import synth_video
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -779,13 +853,24 @@ def _sub_i3d_e2e() -> dict:
         # the reference's one qualitative perf claim is "PWC is faster
         # while RAFT is more accurate" (ref main.py:123-124) — measure it
         pwc = bench_i3d_raft(video, tmp, flow_type="pwc")
-    return {
+        # --preprocess device on the same workload: raw uint8 H2D + taps
+        # vs host PIL min-edge-256 (shape-contracted geometry, PR 2)
+        dev = bench_i3d_raft(video, tmp, flow_type="pwc", preprocess="device")
+    out = {
         "i3d_raft_vps": i3d["best"],
         "i3d_raft_median_vps": i3d["median"],
         "i3d_raft_passes": i3d["passes"],
         "i3d_pwc_vps": pwc["best"],
         "i3d_pwc_median_vps": pwc["median"],
+        "i3d_device_pre_pwc_vps": dev["best"],
+        "i3d_device_pre_pwc_median_vps": dev["median"],
+        "i3d_device_pre_speedup_vs_host": round(dev["best"] / pwc["best"], 3),
     }
+    if jax.default_backend() != "tpu":
+        # same convention as clip_device_only_*: off-TPU numbers are a
+        # smoke, never a reportable device-path measurement
+        out["i3d_device_pre_forced_smoke"] = True
+    return out
 
 
 def _sub_i3d_agg() -> dict:
@@ -810,6 +895,33 @@ def _sub_i3d_agg() -> dict:
     }
 
 
+def _sub_flow_e2e() -> dict:
+    """Standalone RAFT/PWC end-to-end, host vs --preprocess device: the
+    device path ships raw uint8 windows (quarter H2D bytes) and fuses
+    resize+pad into the dispatch via shape-contracted taps."""
+    import jax
+
+    from video_features_tpu.utils.synth import synth_video
+
+    with tempfile.TemporaryDirectory() as tmp:
+        video = synth_video(os.path.join(tmp, "flow.mp4"), **FLOW_SPEC)
+        out = {}
+        for ft in ("raft", "pwc"):
+            host = bench_flow(video, tmp, flow_type=ft)
+            dev = bench_flow(video, tmp, flow_type=ft, preprocess="device")
+            out[f"flow_{ft}_vps"] = host["best"]
+            out[f"flow_{ft}_passes"] = host["passes"]
+            out[f"flow_device_pre_{ft}_vps"] = dev["best"]
+            out[f"flow_device_pre_{ft}_speedup_vs_host"] = round(
+                dev["best"] / host["best"], 3
+            )
+    if jax.default_backend() != "tpu":
+        # same convention as clip_device_only_*: off-TPU numbers are a
+        # smoke, never a reportable device-path measurement
+        out["flow_device_pre_forced_smoke"] = True
+    return out
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -821,6 +933,7 @@ SUB_PARTS = {
     "i3d_device_only": lambda: bench_i3d_device_only(),
     "i3d_e2e": _sub_i3d_e2e,
     "i3d_agg": _sub_i3d_agg,
+    "flow_e2e": _sub_flow_e2e,
     "pallas_corr": lambda: bench_pallas_corr(),
     "flash_attention": lambda: bench_flash_attention(),
 }
@@ -831,6 +944,17 @@ def _run_sub_part(name: str) -> None:
     its dict on a marker line the parent greps out of stdout."""
     part = SUB_PARTS[name]  # unknown name fails before the slow probe
     _probe_backend()
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE")
+    if cache_dir:
+        # persistent jit cache shared across the child processes: each
+        # part re-compiles the same executables (the isolation is the
+        # point), so the cache is where the wall-clock goes on re-runs
+        from video_features_tpu.config import (
+            ExtractionConfig,
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(ExtractionConfig(compile_cache=cache_dir))
     print(_SUB_MARK + json.dumps(part()))
 
 
@@ -954,6 +1078,14 @@ def main() -> None:
         # the headline number's preprocess path; the --preprocess device
         # comparison ships in clip_device_pre_* / clip_mixed_device_*
         "preprocess_mode": "host",
+        "flow_video_synth": FLOW_SPEC,
+        # CPU budget the host-preprocess numbers were produced under —
+        # the PIL decode+resize pool scales with it, the device path
+        # mostly doesn't, so speedup ratios aren't comparable across
+        # hosts without it
+        "host_cores": len(os.sched_getaffinity(0)),
+        "compile_cache": os.environ.get("BENCH_COMPILE_CACHE") or None,
+        "device_contracts": _device_contract_ids(),
     }
 
     # pure-host part FIRST, before any device probe: even a tunnel-dead
@@ -1004,6 +1136,8 @@ def main() -> None:
     part("clip_mixed")
     part("clip_device_only")
     part("pallas_corr")
+    # standalone flow extractors, host vs --preprocess device
+    part("flow_e2e")
 
     if os.environ.get("BENCH_SKIP_I3D") != "1":
         # On TPU the i3d parts default to the decomposed conv3d lowering:

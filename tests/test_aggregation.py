@@ -409,6 +409,71 @@ def test_group_fetch_failure_falls_back_to_solo(four_videos, tmp_path, capsys):
     assert ex.progress.n == 4
 
 
+def test_group_fetch_fallback_reruns_every_member_solo(four_videos, tmp_path, capsys):
+    """The fetch-phase fallback must re-run EXACTLY the failed group's
+    members through the solo path (reusing their kept payloads) — not
+    the whole corpus, and not fewer."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(four_videos, tmp_path, video_batch=2)
+    ex = ExtractCLIP(cfg, external_call=True)
+    calls = {"fetch": 0, "solo": []}
+    real_fetch = ExtractCLIP.fetch_group
+    real_extract = ExtractCLIP.extract_prepared
+
+    def flaky(self, handle):
+        calls["fetch"] += 1
+        if calls["fetch"] == 1:
+            raise RuntimeError("injected fused-fetch failure")
+        return real_fetch(self, handle)
+
+    def counting(self, device, state, entry, payload):
+        calls["solo"].append(entry)
+        return real_extract(self, device, state, entry, payload)
+
+    ex.fetch_group = flaky.__get__(ex)
+    ex.extract_prepared = counting.__get__(ex)
+    results = ex()
+    assert len(results) == 4
+    # exactly the two members of the failed first group re-ran solo
+    assert sorted(calls["solo"]) == sorted(four_videos[:2])
+    assert "An error occurred" not in capsys.readouterr().out
+    assert ex.progress.n == 4
+    solo = ExtractCLIP(_clip_cfg(four_videos, tmp_path), external_call=True)()
+    for s, f in zip(solo, results):
+        np.testing.assert_allclose(
+            f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+
+
+def test_group_fetch_fallback_isolates_truly_bad_member(
+    four_videos, tmp_path, capsys
+):
+    """Fetch-phase counterpart of the dispatch-phase poisoned-member
+    test: when the fused fetch fails AND one member's solo re-run fails
+    too, only that member is lost."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = _clip_cfg(four_videos[:2], tmp_path, video_batch=2)
+    ex = ExtractCLIP(cfg, external_call=True)
+    real_extract = ExtractCLIP.extract_prepared
+
+    def fetch_dies(self, handle):
+        raise RuntimeError("injected fused-fetch failure")
+
+    def solo_poisoned(self, device, state, entry, payload):
+        if entry == four_videos[0]:
+            raise RuntimeError("poisoned member")
+        return real_extract(self, device, state, entry, payload)
+
+    ex.fetch_group = fetch_dies.__get__(ex)
+    ex.extract_prepared = solo_poisoned.__get__(ex)
+    results = ex()
+    assert len(results) == 1  # the good member survived
+    assert capsys.readouterr().out.count("An error occurred") == 1
+    assert ex.progress.n == 2
+
+
 def test_group_fallback_isolates_truly_bad_member(four_videos, tmp_path, capsys):
     """When the fused dispatch fails AND one member really is poisoned
     (its solo dispatch fails too), only that member is reported — the

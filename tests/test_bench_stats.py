@@ -152,6 +152,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "clip_mixed": {"clip_mixed_vps": 2.0},
         "clip_device_only": {"clip_device_only_ips_fp32": 100.0},
         "pallas_corr": {},
+        "flow_e2e": {"flow_raft_vps": 0.3, "flow_device_pre_raft_vps": 0.4},
         "i3d_compile_probe": {"i3d_conv3d_impl": "direct"},
         "i3d_e2e": {"i3d_raft_vps": 0.2},
         "i3d_agg": {"i3d_agg_vps": 0.5},

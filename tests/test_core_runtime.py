@@ -291,3 +291,27 @@ def test_fps_retarget_validation():
                                       fps_retarget="reencode", **base))
     sanity_check(ExtractionConfig(feature_type="pwc",
                                   fps_retarget="reencode", **base))
+
+
+def test_prefetch_frame_cap_byte_budget():
+    """The per-video prefetch cap divides the byte budget over the
+    decode_workers+2 resident prepared-video slots (advisor r02: flat
+    frame caps scaled host RAM with the worker count), with a floor so
+    one minimal work unit always prefetches."""
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.extract.base import BaseExtractor
+
+    def cap(workers, max_bytes=4 << 30, frame_bytes=1 << 20, floor=4):
+        ex = BaseExtractor.__new__(BaseExtractor)
+        ex.config = ExtractionConfig(decode_workers=workers)
+        return ex._prefetch_frame_cap(max_bytes, frame_bytes, floor)
+
+    # 1 worker -> 3 resident slots; 8 workers -> 10
+    assert cap(1) == (4 << 30) // 3 // (1 << 20)
+    assert cap(8) == (4 << 30) // 10 // (1 << 20)
+    assert cap(8) < cap(1)
+    # workers=0 (sync decode) still budgets one slot + 2
+    assert cap(0) == cap(1)
+    # floor wins when the budget rounds down to nothing
+    assert cap(1, max_bytes=1 << 20, floor=65) == 65
+    assert cap(8, max_bytes=0, floor=4) == 4

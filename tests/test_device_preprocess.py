@@ -229,21 +229,43 @@ def test_resnet_device_streaming_fallback_matches(mixed_videos, tmp_path, monkey
     )
 
 
+# the heavyweight flow/i3d device-vs-host extraction runs live in
+# test_device_preprocess_e2e.py (slow tier — RAFT's recurrence is
+# minutes per run on one CPU core); the contract-level parity they
+# depend on is pinned fast in test_shape_contract.py
+
+
 # --- config surface -------------------------------------------------------
 
 def test_preprocess_flag_validation():
     def cfg(**kw):
         return ExtractionConfig(allow_random_init=True, cpu=True, **kw)
 
-    # accepted: CLIP / ResNet families
+    # accepted: CLIP / ResNet families, the flow models, and i3d with an
+    # on-the-fly flow model (PR 2)
     sanity_check(cfg(feature_type="resnet18", preprocess="device"))
     sanity_check(
         cfg(feature_type="CLIP-ViT-B/32", extract_method="uni_4", preprocess="device")
     )
+    sanity_check(cfg(feature_type="raft", preprocess="device"))
+    sanity_check(cfg(feature_type="pwc", preprocess="device"))
+    sanity_check(cfg(feature_type="i3d", preprocess="device"))
+    sanity_check(cfg(feature_type="i3d", preprocess="device", flow_type="raft"))
     with pytest.raises(ValueError, match="preprocess"):
         sanity_check(cfg(feature_type="resnet18", preprocess="nonsense"))
-    with pytest.raises(ValueError, match="preprocess"):
-        sanity_check(cfg(feature_type="i3d", preprocess="device"))
+    # the rejection message names the supported set (single source of
+    # truth: config.DEVICE_PREPROCESS_FEATURE_TYPES)
+    with pytest.raises(ValueError, match="raft.*resnet18|resnet18.*raft"):
+        sanity_check(cfg(feature_type="vggish", preprocess="device"))
+    # pre-extracted disk flow keeps the host chain
+    with pytest.raises(ValueError, match="flow"):
+        sanity_check(cfg(feature_type="i3d", preprocess="device", flow_type="flow"))
+    # --show_pred draws onto host-resized frames the flow device path
+    # never materializes
+    with pytest.raises(ValueError, match="show_pred"):
+        sanity_check(cfg(feature_type="raft", preprocess="device", show_pred=True))
+    with pytest.raises(ValueError, match="show_pred"):
+        sanity_check(cfg(feature_type="pwc", preprocess="device", show_pred=True))
     with pytest.raises(ValueError, match="mesh"):
         sanity_check(
             cfg(feature_type="resnet18", preprocess="device", sharding="mesh")

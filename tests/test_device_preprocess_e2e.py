@@ -1,0 +1,134 @@
+"""--preprocess device e2e for the shape-contracted extractors (PR 2).
+
+Full host-vs-device extraction runs for standalone RAFT/PWC and
+two-stream I3D. These are the heavyweight companions to the fast
+contract-level parity tests in test_shape_contract.py — minutes each on
+one CPU core (RAFT's recurrence dominates), so the whole module is
+``slow``: excluded from the tier-1 `-m 'not slow'` budget and from the
+`-m quick` smoke tier, run by the full CI suite.
+"""
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig, sanity_check
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def tiny_flow_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    root = tmp_path_factory.mktemp("devpre_flow")
+    # small enough that RAFT's 128-px padder floor dominates: both land
+    # on the (128, 128) grid, exercising the identity+edge-pad contract
+    return [
+        synth_video(str(root / "f1.mp4"), n_frames=8, width=100, height=96, seed=3),
+        synth_video(str(root / "f2.mp4"), n_frames=8, width=100, height=96, seed=4),
+    ]
+
+
+def _flow_run(ft, videos, tmp_path, preprocess, video_batch=1, **kw):
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+
+    cls = ExtractRAFT if ft == "raft" else ExtractPWC
+    cfg = ExtractionConfig(
+        allow_random_init=True,
+        feature_type=ft,
+        video_paths=list(videos),
+        batch_size=4,
+        preprocess=preprocess,
+        video_batch=video_batch,
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+        **kw,
+    )
+    sanity_check(cfg)
+    return cls(cfg, external_call=True)()
+
+
+@pytest.mark.parametrize("ft", ["raft", "pwc"])
+def test_flow_device_matches_host(ft, tiny_flow_videos, tmp_path):
+    """No --side_size: the device contract is identity taps + the padder
+    placement, so the model sees bit-identical input and the flow matches
+    the host path to float noise."""
+    host = _flow_run(ft, tiny_flow_videos[:1], tmp_path, "host")
+    dev = _flow_run(ft, tiny_flow_videos[:1], tmp_path, "device")
+    h, d = host[0][ft], dev[0][ft]
+    assert h.shape == d.shape == (7, 2, 96, 100)
+    np.testing.assert_array_equal(host[0]["timestamps_ms"], dev[0]["timestamps_ms"])
+    np.testing.assert_allclose(d, h, atol=1e-4, rtol=0)
+
+
+def test_flow_device_aggregation_matches_solo(tiny_flow_videos, tmp_path):
+    """--video_batch under the device contract: per-window taps stack
+    across the group; fused results must match solo device results."""
+    fused = _flow_run("raft", tiny_flow_videos, tmp_path, "device", video_batch=2)
+    for i, v in enumerate(tiny_flow_videos):
+        solo = _flow_run("raft", [v], tmp_path, "device")[0]
+        np.testing.assert_allclose(
+            fused[i]["raft"], solo["raft"], atol=2e-5, rtol=1e-5
+        )
+
+
+def test_flow_device_side_size_contract(tiny_flow_videos, tmp_path):
+    """--side_size under device preprocess: fused taps resize onto the
+    padder grid of the RESIZED shape; unpad restores that shape."""
+    dev = _flow_run(
+        "raft", tiny_flow_videos[:1], tmp_path, "device", side_size=64
+    )
+    flow = dev[0]["raft"]
+    # (96, 100) min-edge-64 -> (64, 66); channels-first output
+    assert flow.shape == (7, 2, 64, 66)
+    assert np.isfinite(flow).all()
+
+
+def test_flow_device_over_cap_streams_via_host_path(
+    tiny_flow_videos, tmp_path, monkeypatch
+):
+    """Over the prefetch byte cap the device path hands over to the
+    streaming host chain (documented parity-identical fallback)."""
+    from video_features_tpu.models.pwc import extract_pwc as mod
+
+    prepared = _flow_run("pwc", tiny_flow_videos[:1], tmp_path, "device")
+    monkeypatch.setattr(
+        mod.ExtractPWC, "PIPELINE_MAX_BYTES", 1, raising=False
+    )
+    streamed = _flow_run("pwc", tiny_flow_videos[:1], tmp_path, "device")
+    np.testing.assert_allclose(
+        streamed[0]["pwc"], prepared[0]["pwc"], atol=1e-4, rtol=0
+    )
+
+
+def test_i3d_device_two_stream_matches_host(sample_video, tmp_path):
+    """Both I3D streams under --preprocess device: rgb rides crop-fused
+    taps (fixed 224), pwc flow the exact-resized-shape contract. The
+    320x240 synth clip resizes to (256, 341) — bit-clean bilinear taps —
+    so features match the host path to float noise."""
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+
+    def run(preprocess):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            video_paths=[sample_video],
+            flow_type="pwc",
+            extraction_fps=5.0,  # 12 frames -> one 11-frame stack
+            stack_size=10,
+            step_size=10,
+            preprocess=preprocess,
+            tmp_path=str(tmp_path / "tmp"),
+            output_path=str(tmp_path / "out"),
+            cpu=True,
+        )
+        sanity_check(cfg)
+        return ExtractI3D(cfg, external_call=True)([0])[0]
+
+    host, dev = run("host"), run("device")
+    for s in ("rgb", "flow"):
+        assert dev[s].shape == host[s].shape == (1, 1024)
+        np.testing.assert_allclose(dev[s], host[s], atol=1e-4, rtol=0)
+    np.testing.assert_array_equal(dev["timestamps_ms"], host["timestamps_ms"])

@@ -1,0 +1,206 @@
+"""Shape-contracted device resize geometry (ISSUE PR 2 tentpole).
+
+The banded-tap machinery generalized from "fixed 224/256 crop output" to
+arbitrary output contracts: min-edge-256 onto padded output buckets (the
+I3D flow grid), InputPadder /8 grids with the image placed at the host
+pad offsets (standalone RAFT), and exact resized shapes (PWC). Parity is
+pinned against the host oracle — ``pil_resize`` + ``np.pad(mode="edge")``
+— at source resolutions spanning multiple output buckets; the identity
+(no-resize) contracts must be BIT-exact because the inter-pass uint8
+quantization is the identity on integer-valued frames.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from video_features_tpu.models.raft.model import input_grid
+from video_features_tpu.ops.preprocess import device_resize_frames, pil_resize
+from video_features_tpu.ops.resize import resized_hw, shape_contract_banded
+from video_features_tpu.ops.window import flow_output_bucket, pad_hw, spatial_bucket
+
+pytestmark = pytest.mark.quick
+
+RNG = np.random.RandomState(11)
+
+# one uint8 step of PIL's 8-bit fixed-point coefficient table, plus the
+# second pass compounding it — raw [0, 255] scale (the flow models and
+# I3D's chains consume unnormalized frames)
+PIXEL_TOL = 2.5
+
+# >= 4 source resolutions spanning >= 2 output buckets for the min-edge
+# contract: (240,426)/(232,420) -> (256,512); (240,320) -> (256,384);
+# portrait (320,240) -> (384,256)
+SOURCES = [(240, 426), (232, 420), (240, 320), (320, 240)]
+
+
+def _min_edge_oracle(img):
+    """Host chain for the I3D flow grid: min-edge-256 PIL resize, then
+    edge-replicate onto the output bucket at the centered placement."""
+    resized = pil_resize(img, 256)
+    oh, ow = resized.shape[:2]
+    out_h, out_w = flow_output_bucket(oh, ow)
+    top, left = (out_h - oh) // 2, (out_w - ow) // 2
+    padded = np.pad(
+        resized,
+        [(top, out_h - oh - top), (left, out_w - ow - left), (0, 0)],
+        mode="edge",
+    )
+    return padded, (oh, ow), (out_h, out_w), (top, left)
+
+
+def _run_contract(img, resize_to, out_h, out_w, top, left):
+    h, w = img.shape[:2]
+    bh, bw = spatial_bucket(h, w)
+    wt_y, idx_y, wt_x, idx_x = shape_contract_banded(
+        h, w, resize_to, out_h, out_w, top, left, "bilinear",
+        pad_h=bh, pad_w=bw, pad_mode="edge",
+    )
+    out = device_resize_frames(
+        jnp.asarray(pad_hw(img[None], bh, bw)), (wt_y, idx_y), (wt_x, idx_x)
+    )
+    return np.asarray(out)[0]
+
+
+@pytest.mark.parametrize("hw", SOURCES)
+def test_min_edge_bucket_contract_parity(hw):
+    """I3D flow-grid contract: min-edge-256 resize placed centered on the
+    flow output bucket, within one PIL coefficient step of the host
+    resize + edge-pad chain — including the replicated pad rows."""
+    img = RNG.randint(0, 256, (hw[0], hw[1], 3)).astype(np.uint8)
+    ref, (oh, ow), (out_h, out_w), (top, left) = _min_edge_oracle(img)
+    got = _run_contract(img, 256, out_h, out_w, top, left)
+    assert got.shape == (out_h, out_w, 3)
+    assert np.abs(got - ref.astype(np.float32)).max() <= PIXEL_TOL
+
+
+def test_min_edge_sources_span_two_buckets():
+    grids = {
+        flow_output_bucket(*resized_hw(h, w, 256)) for h, w in SOURCES
+    }
+    assert len(grids) >= 2, grids
+
+
+@pytest.mark.parametrize("hw", [(96, 100), (120, 96), (128, 200)])
+def test_identity_padder_contract_bit_exact(hw):
+    """Standalone-RAFT contract without --side_size: no resize, just the
+    InputPadder placement — taps must reproduce host
+    ``np.pad(mode='edge')`` BIT-exactly (quant8 is the identity on
+    integer frames)."""
+    h, w = hw
+    img = RNG.randint(0, 256, (h, w, 3)).astype(np.uint8)
+    tgt_h, tgt_w = input_grid(h, w)
+    top, left = (tgt_h - h) // 2, (tgt_w - w) // 2
+    ref = np.pad(
+        img,
+        [(top, tgt_h - h - top), (left, tgt_w - w - left), (0, 0)],
+        mode="edge",
+    ).astype(np.float32)
+    got = _run_contract(img, 0, tgt_h, tgt_w, top, left)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_resize_padder_contract_parity():
+    """Standalone-flow contract WITH --side_size: min-edge resize onto
+    the exact /8 padder grid of the resized shape."""
+    img = RNG.randint(0, 256, (240, 426, 3)).astype(np.uint8)
+    resized = pil_resize(img, 256)
+    oh, ow = resized.shape[:2]
+    tgt_h, tgt_w = input_grid(oh, ow)
+    top, left = (tgt_h - oh) // 2, (tgt_w - ow) // 2
+    ref = np.pad(
+        resized,
+        [(top, tgt_h - oh - top), (left, tgt_w - ow - left), (0, 0)],
+        mode="edge",
+    ).astype(np.float32)
+    got = _run_contract(img, 256, tgt_h, tgt_w, top, left)
+    assert got.shape == ref.shape
+    assert np.abs(got - ref).max() <= PIXEL_TOL
+
+
+def test_larger_edge_contract_parity():
+    """--resize_to_larger_edge threads through the contract (the flow
+    extractors expose both modes)."""
+    img = RNG.randint(0, 256, (240, 426, 3)).astype(np.uint8)
+    resized = pil_resize(img, 256, resize_to_smaller_edge=False)
+    oh, ow = resized.shape[:2]
+    assert (oh, ow) == resized_hw(240, 426, 256, smaller_edge=False)
+    got = _run_contract_larger(img, 256, oh, ow)
+    assert np.abs(got - resized.astype(np.float32)).max() <= PIXEL_TOL
+
+
+def _run_contract_larger(img, resize_to, out_h, out_w):
+    h, w = img.shape[:2]
+    bh, bw = spatial_bucket(h, w)
+    wt_y, idx_y, wt_x, idx_x = shape_contract_banded(
+        h, w, resize_to, out_h, out_w, 0, 0, "bilinear",
+        pad_h=bh, pad_w=bw, pad_mode="edge", smaller_edge=False,
+    )
+    out = device_resize_frames(
+        jnp.asarray(pad_hw(img[None], bh, bw)), (wt_y, idx_y), (wt_x, idx_x)
+    )
+    return np.asarray(out)[0]
+
+
+@pytest.mark.parametrize("smaller_edge", [True, False])
+@pytest.mark.parametrize(
+    "hw", [(240, 426), (426, 240), (256, 256), (100, 640), (256, 300)]
+)
+def test_resized_hw_matches_pil(hw, smaller_edge):
+    """resized_hw replays PIL's integer output geometry in both edge
+    modes, including the matched-edge early return."""
+    img = np.zeros((hw[0], hw[1], 3), np.uint8)
+    ref = pil_resize(img, 256, resize_to_smaller_edge=smaller_edge)
+    assert resized_hw(hw[0], hw[1], 256, smaller_edge) == ref.shape[:2]
+
+
+def test_flow_output_bucket_geometry():
+    # multiple=div collapses to the exact padder grid
+    assert flow_output_bucket(256, 454, multiple=8) == input_grid(256, 454)
+    # default 64-multiple rounds the padder grid up
+    assert flow_output_bucket(256, 454) == (256, 512)
+    assert flow_output_bucket(256, 341) == (256, 384)
+    # the 128-px padder floor survives the bucketing
+    assert flow_output_bucket(96, 100) == (128, 128)
+
+
+def test_per_window_taps_match_solo():
+    """The fused flow agg path stacks per-window (G, P, K) taps; results
+    must be bit-identical to running each window solo."""
+    imgs = [
+        RNG.randint(0, 256, (96, 100, 3)).astype(np.uint8),
+        RNG.randint(0, 256, (96, 100, 3)).astype(np.uint8),
+    ]
+    h, w = 96, 100
+    bh, bw = spatial_bucket(h, w)
+    tgt_h, tgt_w = input_grid(h, w)
+    top, left = (tgt_h - h) // 2, (tgt_w - w) // 2
+    wt_y, idx_y, wt_x, idx_x = shape_contract_banded(
+        h, w, 0, tgt_h, tgt_w, top, left, "bilinear",
+        pad_h=bh, pad_w=bw, pad_mode="edge",
+    )
+    frames = np.stack([pad_hw(im[None], bh, bw)[0] for im in imgs])
+    solo = [
+        np.asarray(
+            device_resize_frames(
+                jnp.asarray(f[None]), (wt_y, idx_y), (wt_x, idx_x)
+            )
+        )[0]
+        for f in frames
+    ]
+    g = lambda a: np.stack([a, a])
+    group = np.asarray(
+        device_resize_frames(
+            jnp.asarray(frames[:, None]), (g(wt_y), g(idx_y)), (g(wt_x), g(idx_x))
+        )
+    )
+    np.testing.assert_array_equal(group[0, 0], solo[0])
+    np.testing.assert_array_equal(group[1, 0], solo[1])
+
+
+def test_contract_rejects_escaping_placement():
+    with pytest.raises(ValueError):
+        shape_contract_banded(
+            240, 426, 256, 200, 200, 0, 0, "bilinear", pad_mode="edge"
+        )

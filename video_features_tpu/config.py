@@ -35,6 +35,15 @@ FEATURE_TYPES = [
 RESNET_FEATURE_TYPES = [f"resnet{d}" for d in (18, 34, 50, 101, 152)]
 CLIP_FEATURE_TYPES = ["CLIP-ViT-B/32", "CLIP-ViT-B/16", "CLIP4CLIP-ViT-B-32"]
 
+# extractors whose dispatch honors --preprocess device: the image models
+# (fixed 224-crop contract), the flow models (InputPadder-/exact-grid
+# contract) and I3D (min-edge-256 output-bucket contract). sanity_check
+# names this set in its rejection message, so it stays the single source
+# of truth as coverage grows.
+DEVICE_PREPROCESS_FEATURE_TYPES = (
+    CLIP_FEATURE_TYPES + RESNET_FEATURE_TYPES + ["raft", "pwc", "i3d"]
+)
+
 
 @dataclass
 class ExtractionConfig:
@@ -294,11 +303,23 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
     if cfg.preprocess not in ("host", "device"):
         raise ValueError(f"unknown preprocess mode: {cfg.preprocess}")
     if cfg.preprocess == "device":
-        if cfg.feature_type not in CLIP_FEATURE_TYPES + RESNET_FEATURE_TYPES:
+        if cfg.feature_type not in DEVICE_PREPROCESS_FEATURE_TYPES:
+            supported = ", ".join(sorted(DEVICE_PREPROCESS_FEATURE_TYPES))
             raise ValueError(
-                "--preprocess device covers the image-model extractors "
-                "(CLIP family, resnet*) — the flow/3D-conv families keep "
-                f"their own device chains (got {cfg.feature_type!r})"
+                "--preprocess device currently covers: "
+                f"{supported} (got {cfg.feature_type!r})"
+            )
+        if cfg.feature_type == "i3d" and cfg.flow_type == "flow":
+            raise ValueError(
+                "--preprocess device on i3d requires an on-the-fly flow "
+                "model (--flow_type raft or pwc); pre-extracted disk flow "
+                "keeps the host chain (frames arrive already resized)"
+            )
+        if cfg.show_pred and cfg.feature_type in ("raft", "pwc"):
+            raise ValueError(
+                "--show_pred draws flow onto host-resized frames, which "
+                "--preprocess device never materializes for raft/pwc — "
+                "drop one of the two flags"
             )
         if cfg.sharding == "mesh":
             raise ValueError(
@@ -419,11 +440,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="aggregate up to N videos' prepared batches into "
                         "one device dispatch (CLIP/ResNet/R21D); 1 = off")
     p.add_argument("--preprocess", default="host", choices=["host", "device"],
-                   help="where the resize/crop/normalize chain runs for "
-                        "CLIP/ResNet: 'host' (reference-exact PIL, the "
-                        "default) or 'device' (raw uint8 frames H2D, one "
-                        "fused jit does bicubic/bilinear resize + crop + "
-                        "normalize + encoder forward)")
+                   help="where the resize/crop/normalize chain runs: "
+                        "'host' (reference-exact PIL, the default) or "
+                        "'device' (raw uint8 frames H2D, one fused jit "
+                        "does the PIL-semantics resize + geometry + model "
+                        "forward). Covers CLIP/ResNet (224-crop "
+                        "contract), raft/pwc (padded flow-grid contract) "
+                        "and i3d (min-edge-256 output buckets) — see "
+                        "docs/tpu.md's coverage matrix")
     p.add_argument("--spatial_bucket", type=int, default=64,
                    help="--preprocess device: round each source-resolution "
                         "axis up to a multiple of this before compiling "

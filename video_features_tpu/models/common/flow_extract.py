@@ -75,6 +75,44 @@ class PairwiseFlowExtractor(BaseExtractor):
     def _make_padder(self, shape):
         return NullPadder()
 
+    # --- shape-contracted device preprocess (--preprocess device) ----------
+    # The host chain is decode -> optional --side_size PIL resize ->
+    # float32 -> padder.pad -> model. Under --preprocess device those
+    # collapse: raw uint8 HWC windows ship over H2D (4x fewer bytes) and
+    # banded taps (ops/resize.py::shape_contract_banded) resize each
+    # source frame DIRECTLY onto the model's padded grid — the /8
+    # InputPadder target for RAFT (the replicate-pad rows are baked into
+    # the taps), the exact resized shape for PWC (its /64 stretch lives
+    # in-model and must see unpadded geometry, models/pwc/model.py::
+    # internal_grid). With no --side_size the taps are the identity band,
+    # so the device path is bit-exact against host ``InputPadder.pad``.
+
+    def _device_grid(self, oh: int, ow: int):
+        """(out_h, out_w, top, left): where the resized (oh, ow) image
+        lands in the device output contract. Base: the exact resized
+        shape (PWC). ExtractRAFT overrides with its InputPadder /8
+        grid and centered placement."""
+        return oh, ow, 0, 0
+
+    def _device_contract(self, h: int, w: int):
+        """(wy, wx, (bh, bw), (oh, ow)) for a source resolution: banded
+        taps onto this extractor's output grid, the input spatial bucket
+        the raw frames pad to, and the resized shape the per-video padder
+        (and hence ``unpad``) is built from."""
+        from video_features_tpu.ops.resize import resized_hw, shape_contract_banded
+        from video_features_tpu.ops.window import spatial_bucket
+
+        side = int(self.side_size) if self.side_size is not None else 0
+        smaller = bool(self.resize_to_smaller_edge)
+        oh, ow = resized_hw(h, w, side, smaller) if side else (h, w)
+        out_h, out_w, top, left = self._device_grid(oh, ow)
+        bh, bw = spatial_bucket(h, w, self.config.spatial_bucket)
+        wt_y, idx_y, wt_x, idx_x = shape_contract_banded(
+            h, w, side, out_h, out_w, top, left, "bilinear",
+            pad_h=bh, pad_w=bw, pad_mode="edge", smaller_edge=smaller,
+        )
+        return (wt_y, idx_y), (wt_x, idx_x), (bh, bw), (oh, ow)
+
     # --- runtime -----------------------------------------------------------
     def _load_host_params(self):
         if self._host_params is None:
@@ -127,7 +165,7 @@ class PairwiseFlowExtractor(BaseExtractor):
         def forward_group(p, windows):  # (G, B+1, Hp, Wp, 3)
             return jax.vmap(lambda w: model.apply({"params": p}, w))(windows)
 
-        return {
+        fns = {
             "params": params,
             "forward": forward,
             "forward_group": jax.jit(
@@ -135,6 +173,32 @@ class PairwiseFlowExtractor(BaseExtractor):
             ),
             "device": device,
         }
+
+        if self._device_preprocess_enabled() and not is_mesh(device):
+            from video_features_tpu.ops.preprocess import device_resize_frames
+
+            def forward_raw(p, x_u8, wy, wx):
+                # uint8 (B+1, bh, bw, 3) + shared (P, K) taps -> flow on
+                # the contracted grid; resize+pad+float32 fuse into the
+                # flow-model dispatch
+                x = device_resize_frames(x_u8, wy, wx)
+                return model.apply({"params": p}, x)
+
+            def forward_raw_group(p, xs_u8, wy, wx):
+                # (G, B+1, bh, bw, 3) with PER-WINDOW (G, P, K) taps:
+                # mixed source resolutions fuse whenever they share the
+                # (input bucket, output grid, K) contract
+                x = device_resize_frames(xs_u8, wy, wx)
+                return jax.vmap(lambda w: model.apply({"params": p}, w))(x)
+
+            fns["forward_raw"] = jax.jit(
+                forward_raw, **multihost_out_kwargs(device)
+            )
+            fns["forward_raw_group"] = jax.jit(
+                forward_raw_group, **multihost_out_kwargs(device)
+            )
+
+        return fns
 
     def _preprocess(self, frame: np.ndarray) -> np.ndarray:
         if self.side_size is not None:
@@ -238,16 +302,23 @@ class PairwiseFlowExtractor(BaseExtractor):
         # serial path where the frames are still in hand
         if self.config.show_pred:
             return ("stream", path_entry)
+        from video_features_tpu.ops.window import pad_hw
+
         video_path = video_path_of(path_entry)
         fps = (self.config.extraction_fps
                or probe(video_path, self.config.decoder).fps or 25.0)
         decode_path, sel_fps = self._fps_source(video_path)
 
+        # device preprocess keeps windows as raw uint8 at the input
+        # bucket (4x more frames fit under the same byte cap; the resize
+        # happens in-dispatch against the contract taps)
+        device_pre = self._device_preprocess_enabled()
         windows: List[np.ndarray] = []
         n_pairs: List[int] = []
         timestamps_ms: List[float] = []
         batch: List[np.ndarray] = []
         padder = None
+        contract = None
         cap = None
         count = 0
 
@@ -257,17 +328,28 @@ class PairwiseFlowExtractor(BaseExtractor):
             # the n_pairs slice), exactly like _dispatch_batch
             n = len(batch) - 1
             window = batch + [batch[-1]] * (self.batch_size + 1 - len(batch))
-            windows.append(padder.pad(np.stack(window)))
+            if device_pre:
+                windows.append(pad_hw(np.stack(window), *contract[2]))
+            else:
+                windows.append(padder.pad(np.stack(window)))
             n_pairs.append(n)
 
         for frame, ts in stream_frames(
             decode_path, sel_fps, self.config.decoder
         ):
             count += 1
-            frame = self._preprocess(frame)
+            if not device_pre:
+                frame = self._preprocess(frame)
             if padder is None:
-                padder = self._make_padder(frame.shape[:2])
-                cap = self._window_cap(padder.pad(frame[None])[0])
+                if device_pre:
+                    contract = self._device_contract(*frame.shape[:2])
+                    # the padder serves fetch-side unpad: built from the
+                    # RESIZED shape, whose grid the taps target
+                    padder = self._make_padder(contract[3])
+                    cap = self._window_cap(pad_hw(frame[None], *contract[2])[0])
+                else:
+                    padder = self._make_padder(frame.shape[:2])
+                    cap = self._window_cap(padder.pad(frame[None])[0])
             if count > cap:
                 # too big to prefetch whole; hand the resolved decode
                 # source over so a completed re-encode isn't re-run
@@ -281,6 +363,9 @@ class PairwiseFlowExtractor(BaseExtractor):
             flush(batch)
         if padder is None:
             raise IOError(f"no frames decoded from {video_path}")
+        if device_pre:
+            head = ("dev", windows, contract[0], contract[1])
+            return head, n_pairs, padder, fps, timestamps_ms
         return windows, n_pairs, padder, fps, timestamps_ms
 
     def _mesh_fill(self, state, w: np.ndarray) -> np.ndarray:
@@ -307,9 +392,22 @@ class PairwiseFlowExtractor(BaseExtractor):
             return ("done", self.extract(device, state, payload[1], source))
         from video_features_tpu.parallel.sharding import place_batch
 
-        windows, n_pairs, padder, fps, timestamps_ms = payload
+        head, n_pairs, padder, fps, timestamps_ms = payload
+        if isinstance(head, tuple) and head[0] == "dev":
+            # device contract: raw uint8 windows + shared taps (sanity
+            # rejects device+mesh, so no _mesh_fill here)
+            _, windows, wy, wx = head
+            wy = tuple(place_batch(a, state["device"]) for a in wy)
+            wx = tuple(place_batch(a, state["device"]) for a in wx)
+            outs = []
+            for w, n in zip(windows, n_pairs):
+                x = place_batch(w, state["device"])
+                outs.append(
+                    (state["forward_raw"](state["params"], x, wy, wx), n)
+                )
+            return ("batched", outs, padder, fps, timestamps_ms)
         outs = []
-        for w, n in zip(windows, n_pairs):
+        for w, n in zip(head, n_pairs):
             x = place_batch(self._mesh_fill(state, w), state["device"])
             outs.append((state["forward"](state["params"], x), n))
         return ("batched", outs, padder, fps, timestamps_ms)
@@ -341,7 +439,19 @@ class PairwiseFlowExtractor(BaseExtractor):
     def agg_key(self, payload):
         if payload[0] == "stream":
             return None
-        windows = payload[0]
+        head = payload[0]
+        if isinstance(head, tuple) and head[0] == "dev":
+            _, windows, wy, wx = head
+            if not windows:
+                return None
+            if len(windows) * windows[0].nbytes > self.AGG_MAX_BYTES:
+                return None
+            # fuse per (input bucket window shape, output grid, K): the
+            # output-bucket id rides in via the tap shapes (out_h, K) /
+            # (out_w, K) — mixed source resolutions sharing the contract
+            # stack their per-window taps in dispatch_group
+            return ("dev", windows[0].shape, wy[0].shape, wx[0].shape)
+        windows = head
         # a 1-frame video makes zero pairs, hence zero windows — nothing
         # to fuse; the solo path returns its empty flow array
         if not windows:
@@ -355,6 +465,39 @@ class PairwiseFlowExtractor(BaseExtractor):
         from video_features_tpu.parallel.sharding import pad_batch_for, place_batch
 
         group = max(int(self.config.video_batch or 1), 1)
+        head0 = payloads[0][0]
+        if isinstance(head0, tuple) and head0[0] == "dev":
+            # per-window taps: each window resizes with its own video's
+            # contract, so mixed resolutions sharing the agg key fuse.
+            # pad_batch's zero taps feed zero frames to the pad windows,
+            # whose outputs the [:g] slice drops anyway.
+            flat_w, flat_taps, flat_n = [], [], []
+            for p in payloads:
+                _, wins, wy, wx = p[0]
+                flat_w.extend(wins)
+                flat_taps.extend([(wy, wx)] * len(wins))
+                flat_n.extend(p[1])
+            outs = []
+            for i in range(0, len(flat_w), group):
+                chunk = flat_w[i : i + group]
+                taps = flat_taps[i : i + group]
+                g = len(chunk)
+                x = place_batch(
+                    pad_batch(np.stack(chunk), group), state["device"]
+                )
+                wy_g = tuple(
+                    pad_batch(np.stack([t[0][k] for t in taps]), group)
+                    for k in (0, 1)
+                )
+                wx_g = tuple(
+                    pad_batch(np.stack([t[1][k] for t in taps]), group)
+                    for k in (0, 1)
+                )
+                outs.append(
+                    (state["forward_raw_group"](state["params"], x, wy_g, wx_g), g)
+                )
+            metas = [(len(p[0][1]), p[2], p[3], p[4]) for p in payloads]
+            return outs, flat_n, metas
         flat_w = [w for p in payloads for w in p[0]]
         flat_n = [n for p in payloads for n in p[1]]
         outs = []

@@ -71,6 +71,75 @@ WEIGHT_FILES = {
 }
 
 
+@functools.lru_cache(maxsize=256)
+def _device_geometry(h: int, w: int, bucket_multiple: int, flow_type: str):
+    """Shape contracts for one raw source resolution under ``--preprocess
+    device`` (both streams):
+
+    - rgb: the min-edge-256 resize composes with the reference's FLOOR
+      center crop into crop-fused taps — a fixed (224, 224) output, so
+      the rgb stream needs no output bucket at all.
+    - flow: min-edge-256 taps resize onto an OUTPUT BUCKET — the RAFT
+      InputPadder /8 grid of the resized shape rounded up to
+      ``bucket_multiple`` (ops/window.py::flow_output_bucket) so a
+      variable-resolution corpus compiles O(buckets) flow executables —
+      with the image edge-replicated at the centered InputPadder
+      placement (the validity contract: input-bucket pad columns carry
+      zero tap weight, output pad rows repeat the image edge exactly as
+      host ``np.pad(mode="edge")`` would). PWC instead stretches to /64
+      in-model, so its contract is the EXACT resized shape (bucketing
+      would squash the geometry). The 224-crop offsets into the flow
+      grid are returned as int32 scalars and ship as jit INPUTS
+      (ops/preprocess.py::dynamic_center_crop), so the crop position can
+      vary per source while the executable stays per-bucket.
+    """
+    from video_features_tpu.models.raft.model import input_grid
+    from video_features_tpu.ops.resize import (
+        fused_resize_crop_banded,
+        resized_hw,
+        shape_contract_banded,
+    )
+    from video_features_tpu.ops.window import flow_output_bucket, spatial_bucket
+
+    bh, bw = spatial_bucket(h, w, bucket_multiple)
+    oh, ow = resized_hw(h, w, MIN_SIDE_SIZE)
+    rgb_wy_t, rgb_wy_i, rgb_wx_t, rgb_wx_i = fused_resize_crop_banded(
+        h, w, MIN_SIDE_SIZE, CENTRAL_CROP_SIZE, "bilinear",
+        pad_h=bh, pad_w=bw, crop_offset="floor",
+    )
+    if flow_type == "raft":
+        tgt_h, tgt_w = input_grid(oh, ow)
+        out_h, out_w = flow_output_bucket(oh, ow, multiple=bucket_multiple)
+        top, left = (out_h - oh) // 2, (out_w - ow) // 2
+        # host crops the /8-PADDED flow with floor offsets; replay that
+        # region relative to where the bucket places the image
+        fh = top + (tgt_h - CENTRAL_CROP_SIZE) // 2 - (tgt_h - oh) // 2
+        fw = left + (tgt_w - CENTRAL_CROP_SIZE) // 2 - (tgt_w - ow) // 2
+    else:  # pwc: exact resized grid, host-identical floor crop
+        out_h, out_w, top, left = oh, ow, 0, 0
+        fh = (oh - CENTRAL_CROP_SIZE) // 2
+        fw = (ow - CENTRAL_CROP_SIZE) // 2
+    if not (0 <= fh <= out_h - CENTRAL_CROP_SIZE
+            and 0 <= fw <= out_w - CENTRAL_CROP_SIZE):
+        raise AssertionError(
+            f"flow crop {(fh, fw)} escapes the {(out_h, out_w)} grid "
+            f"for source {(h, w)}"
+        )
+    f_wy_t, f_wy_i, f_wx_t, f_wx_i = shape_contract_banded(
+        h, w, MIN_SIDE_SIZE, out_h, out_w, top, left, "bilinear",
+        pad_h=bh, pad_w=bw, pad_mode="edge",
+    )
+    return {
+        "bucket": (bh, bw),
+        "grid": (out_h, out_w),
+        "rgb_wy": (rgb_wy_t, rgb_wy_i),
+        "rgb_wx": (rgb_wx_t, rgb_wx_i),
+        "flow_wy": (f_wy_t, f_wy_i),
+        "flow_wx": (f_wx_t, f_wx_i),
+        "crop": (np.int32(fh), np.int32(fw)),
+    }
+
+
 def center_crop(x: jnp.ndarray, crop: int = CENTRAL_CROP_SIZE) -> jnp.ndarray:
     """(..., H, W, C) tensor-space center crop (ref transforms.py:7-18)."""
     H, W = x.shape[-3], x.shape[-2]
@@ -228,13 +297,77 @@ class ExtractI3D(BaseExtractor):
         frames) need no host-side padding."""
         from video_features_tpu.parallel.sharding import is_mesh
 
-        key = tuple(shape)
+        key = ("dev",) if self._device_preprocess_enabled() else tuple(shape)
         if key in state["fns"]:
             return state["fns"][key]
         i3d = i3d_build(
             dtype=state.get("dtype", jnp.float32), conv_impl=self.conv_impl
         )
         fns = {}
+
+        if key == ("dev",):
+            # shape-contracted device preprocess: ONE set of jitted fns
+            # regardless of source resolution — the taps, raw uint8
+            # stacks, and crop offsets are all INPUTS, so jax.jit's own
+            # shape cache compiles one executable per (input bucket,
+            # output grid) contract rather than per source shape.
+            # sanity_check guarantees flow_type raft/pwc and no mesh.
+            from video_features_tpu.ops.preprocess import (
+                device_resize_frames,
+                dynamic_center_crop,
+            )
+
+            if "rgb" in self.streams:
+
+                @jax.jit
+                def rgb_fn(p, stacks, wy, wx):
+                    # (B, S+1, bh, bw, 3) uint8; crop-fused taps land the
+                    # min-edge-256 resize + floor 224-crop in one pass
+                    x = device_resize_frames(stacks[:, :-1], wy, wx)
+                    return i3d.apply({"params": p}, scale_to_1_1(x))
+
+                fns["rgb"] = rgb_fn
+
+            if "flow" in self.streams and self.flow_type == "raft":
+                from video_features_tpu.models.raft.model import build as raft_build
+
+                raft = raft_build(dtype=state.get("dtype", jnp.float32))
+
+                @jax.jit
+                def flow_fn(p_flow, p_i3d, stacks, wy, wx, fh, fw):
+                    # taps place the resized image on the /8 output
+                    # bucket with edge replication — InputPadder's pad is
+                    # already inside the resize
+                    x = device_resize_frames(stacks, wy, wx)
+                    flow = jax.vmap(
+                        lambda s: raft.apply({"params": p_flow}, s)
+                    )(x)
+                    f = dynamic_center_crop(flow, fh, fw, CENTRAL_CROP_SIZE)
+                    f = scale_to_1_1(flow_to_uint8(f))
+                    return i3d.apply({"params": p_i3d}, f)
+
+                fns["flow"] = flow_fn
+            elif "flow" in self.streams and self.flow_type == "pwc":
+                from video_features_tpu.models.pwc.model import build as pwc_build
+
+                pwc = pwc_build(dtype=state.get("dtype", jnp.float32))
+
+                @jax.jit
+                def flow_fn(p_flow, p_i3d, stacks, wy, wx, fh, fw):
+                    # exact (oh, ow) contract — PWC's in-model /64
+                    # stretch must see the true resized geometry
+                    x = device_resize_frames(stacks, wy, wx)
+                    flow = jax.vmap(
+                        lambda s: pwc.apply({"params": p_flow}, s)
+                    )(x)
+                    f = dynamic_center_crop(flow, fh, fw, CENTRAL_CROP_SIZE)
+                    f = scale_to_1_1(flow_to_uint8(f))
+                    return i3d.apply({"params": p_i3d}, f)
+
+                fns["flow"] = flow_fn
+
+            state["fns"][key] = fns
+            return fns
 
         if is_mesh(state["device"]):
             # mesh: per-stack fns, the FRAME axis shards (untouched by
@@ -511,6 +644,16 @@ class ExtractI3D(BaseExtractor):
         ]
         return frames, fps, timestamps_ms
 
+    def _decode_raw(self, video_path, meta=None):
+        """--preprocess device: the min-edge-256 resize moves on-chip
+        (``_device_geometry`` taps), so prepare hands over RAW uint8
+        frames — a quarter of the float32 bytes per pixel the
+        host-resized path prefetches and ships over PCIe."""
+        frames, fps, timestamps_ms = self._sample_frames(video_path, meta)
+        if not frames:
+            raise IOError(f"no frames decoded from {video_path}")
+        return frames, fps, timestamps_ms
+
     def prepare(self, path_entry):
         from_disk = self.flow_type == "flow"
         if from_disk and (
@@ -523,6 +666,13 @@ class ExtractI3D(BaseExtractor):
         video_path = video_path_of(path_entry)
         meta = probe(video_path, self.config.decoder)
         cost = self._sampled_count(meta)
+        device_pre = self._device_preprocess_enabled()
+        if device_pre:
+            # raw uint8 frames prefetch at SOURCE resolution — restate
+            # the cap's resized-float32 frame unit in those bytes
+            cost = max(
+                cost * (meta.height * meta.width * 3) // self._FRAME_BYTES, 1
+            )
         pairs = self._load_flow_pairs(path_entry[1]) if from_disk else None
         if from_disk:
             cost += self._flow_prefetch_cost(pairs)
@@ -533,7 +683,8 @@ class ExtractI3D(BaseExtractor):
         flow_imgs = (
             self._read_flow_images(path_entry[1], pairs) if from_disk else None
         )
-        return self._decode_resized(video_path, meta), flow_imgs, from_disk, meta
+        decode = self._decode_raw if device_pre else self._decode_resized
+        return decode(video_path, meta), flow_imgs, from_disk, meta
 
     def dispatch_prepared(self, device, state, path_entry, payload):
         from jax.sharding import PartitionSpec as P
@@ -541,12 +692,21 @@ class ExtractI3D(BaseExtractor):
         from video_features_tpu.parallel.sharding import is_mesh, place_batch
 
         decoded, flow_imgs, from_disk, meta = payload
+        device_pre = self._device_preprocess_enabled()
         if decoded is None:  # over the prefetch cap: load here, held once
             if from_disk:
                 flow_imgs = self._read_flow_images(path_entry[1])
-            decoded = self._decode_resized(video_path_of(path_entry), meta)
+            decode = self._decode_raw if device_pre else self._decode_resized
+            decoded = decode(video_path_of(path_entry), meta)
         frames, fps, timestamps_ms = decoded
         fns = self._fns_for_shape(state, frames[0].shape[:2])
+        geom = (
+            _device_geometry(
+                *frames[0].shape[:2], self.config.spatial_bucket, self.flow_type
+            )
+            if device_pre
+            else None
+        )
 
         feats: Dict[str, List[np.ndarray]] = {s: [] for s in self.streams}
         preds: List[tuple] = []  # (stack_idx, stream, logits) if show_pred
@@ -574,14 +734,16 @@ class ExtractI3D(BaseExtractor):
             else:  # stack-batched: the last group zero-pads to the full
                 # shape (ops/window.py pad_batch, the shared static-shape
                 # idiom); surplus outputs are sliced off at fetch
-                from video_features_tpu.ops.window import pad_batch
+                from video_features_tpu.ops.window import pad_batch, pad_hw
 
-                x = place_batch(
-                    pad_batch(
-                        np.stack([np.stack(frames[s:e]) for s, e in chunk]), group
-                    ),
-                    state["device"],
+                stacked = pad_batch(
+                    np.stack([np.stack(frames[s:e]) for s, e in chunk]), group
                 )
+                if device_pre:
+                    # raw uint8 onto the input bucket; pad columns carry
+                    # zero tap weight, so they never reach the models
+                    stacked = pad_hw(stacked, *geom["bucket"])
+                x = place_batch(stacked, state["device"])
                 fl = (
                     place_batch(
                         pad_batch(
@@ -594,10 +756,23 @@ class ExtractI3D(BaseExtractor):
                 )
             outs = []
             for stream in self.streams:
-                if stream == "rgb":
+                if stream == "rgb" and device_pre:
+                    f, logits = fns["rgb"](
+                        state["params"]["rgb"], x, geom["rgb_wy"], geom["rgb_wx"]
+                    )
+                elif stream == "rgb":
                     f, logits = fns["rgb"](state["params"]["rgb"], x)
                 elif from_disk:
                     f, logits = fns["flow"](state["params"]["flow"], fl)
+                elif device_pre:
+                    f, logits = fns["flow"](
+                        state["params"][self.flow_type],
+                        state["params"]["flow"],
+                        x,
+                        geom["flow_wy"],
+                        geom["flow_wx"],
+                        *geom["crop"],
+                    )
                 else:
                     f, logits = fns["flow"](
                         state["params"][self.flow_type], state["params"]["flow"], x
@@ -649,20 +824,27 @@ class ExtractI3D(BaseExtractor):
         # the spurious solo_fallback traceback to the right answer)
         if len(frames) < self.stack_size + 1:
             return None
-        return (
+        key = (
             frames[0].shape[:2],
             self.stack_size,
             self.step_size,
             tuple(self.streams),
             self.flow_type,
         )
+        if self._device_preprocess_enabled():
+            # frames are RAW here, so shape[:2] is the source resolution:
+            # same (h, w) -> the same _device_geometry taps serve the
+            # whole group on one padded-bucket executable
+            key = key + ("dev",)
+        return key
 
     def dispatch_group(self, device, state, entries, payloads):
-        from video_features_tpu.ops.window import pad_batch
+        from video_features_tpu.ops.window import pad_batch, pad_hw
         from video_features_tpu.parallel.sharding import place_batch
 
         group = self.stack_batch
         window = self.stack_size + 1
+        device_pre = self._device_preprocess_enabled()
         stacks: List[np.ndarray] = []
         counts: List[int] = []
         metas = []
@@ -673,15 +855,38 @@ class ExtractI3D(BaseExtractor):
             counts.append(len(slices))
             metas.append((fps, timestamps_ms))
         fns = self._fns_for_shape(state, stacks[0].shape[1:3])
+        geom = (
+            _device_geometry(
+                *stacks[0].shape[1:3], self.config.spatial_bucket, self.flow_type
+            )
+            if device_pre
+            else None
+        )
         outs = []
         for i in range(0, len(stacks), group):
             chunk = stacks[i : i + group]
             n_valid = len(chunk)
-            x = place_batch(pad_batch(np.stack(chunk), group), state["device"])
+            stacked = pad_batch(np.stack(chunk), group)
+            if device_pre:
+                stacked = pad_hw(stacked, *geom["bucket"])
+            x = place_batch(stacked, state["device"])
             souts = []
             for stream in self.streams:
-                if stream == "rgb":
+                if stream == "rgb" and device_pre:
+                    f, _ = fns["rgb"](
+                        state["params"]["rgb"], x, geom["rgb_wy"], geom["rgb_wx"]
+                    )
+                elif stream == "rgb":
                     f, _ = fns["rgb"](state["params"]["rgb"], x)
+                elif device_pre:
+                    f, _ = fns["flow"](
+                        state["params"][self.flow_type],
+                        state["params"]["flow"],
+                        x,
+                        geom["flow_wy"],
+                        geom["flow_wx"],
+                        *geom["crop"],
+                    )
                 else:
                     f, _ = fns["flow"](
                         state["params"][self.flow_type],

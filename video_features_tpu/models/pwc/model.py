@@ -206,6 +206,17 @@ class Refiner(nn.Module):
         return _conv(2, name="conv6", dtype=self.dtype)(feat).astype(jnp.float32)
 
 
+def internal_grid(h: int, w: int, div: int = 64) -> Tuple[int, int]:
+    """The /``div`` (Hp, Wp) grid PWC stretches its input to inside the
+    forward pass (ref pwc_net.py:234-238) — unlike RAFT's replicate pad
+    this is an aspect-breaking bilinear stretch, so device-preprocess
+    contracts for PWC must deliver the EXACT (h, w) the host path would
+    (padding the input would squash the image); the helper exists so the
+    bench bucket histogram and the docs matrix can name the grid PWC
+    actually compiles at."""
+    return int(math.ceil(h / div) * div), int(math.ceil(w / div) * div)
+
+
 class PWCNet(nn.Module):
     """(T, H, W, 3) RGB floats in [0,255] -> (T-1, H, W, 2) flow for each
     consecutive frame pair, at input resolution.
@@ -219,8 +230,7 @@ class PWCNet(nn.Module):
     def __call__(self, frames: jnp.ndarray) -> jnp.ndarray:
         T, H, W, _ = frames.shape
         x = frames[..., ::-1] / 255.0  # RGB -> BGR, [0,1] (ref pwc_net.py:230-231)
-        Hp = int(math.ceil(H / 64.0) * 64)
-        Wp = int(math.ceil(W / 64.0) * 64)
+        Hp, Wp = internal_grid(H, W)
         x = jnp.moveaxis(
             resize_bilinear(jnp.moveaxis(x, -1, -3), (Hp, Wp), align_corners=False),
             -3,

@@ -13,7 +13,7 @@ import numpy as np
 
 from video_features_tpu.models.common.flow_extract import PairwiseFlowExtractor
 from video_features_tpu.models.raft.convert import convert_state_dict
-from video_features_tpu.models.raft.model import build, init_params
+from video_features_tpu.models.raft.model import build, init_params, input_grid
 
 
 class InputPadder:
@@ -29,8 +29,7 @@ class InputPadder:
 
     def __init__(self, shape: Tuple[int, int], div: int = 8, min_size: int = 128):
         self.ht, self.wd = shape
-        tgt_ht = max(-(-self.ht // div) * div, min_size)
-        tgt_wd = max(-(-self.wd // div) * div, min_size)
+        tgt_ht, tgt_wd = input_grid(self.ht, self.wd, div, min_size)
         pad_ht, pad_wd = tgt_ht - self.ht, tgt_wd - self.wd
         self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, pad_ht // 2, pad_ht - pad_ht // 2]
 
@@ -64,3 +63,11 @@ class ExtractRAFT(PairwiseFlowExtractor):
 
     def _make_padder(self, shape):
         return InputPadder(shape)
+
+    def _device_grid(self, oh, ow):
+        # the device-preprocess output contract IS InputPadder's target:
+        # /8 multiples with the 128-px floor, image centered exactly
+        # where the 'sintel'-mode pad puts it (pad_ht//2 == (tgt-oh)//2),
+        # so the per-video padder's unpad slices the same valid region
+        tgt_h, tgt_w = input_grid(oh, ow)
+        return tgt_h, tgt_w, (tgt_h - oh) // 2, (tgt_w - ow) // 2

@@ -355,6 +355,19 @@ class RAFT(nn.Module):
         return upsample_flow(coords1 - coords0, mask)
 
 
+def input_grid(
+    h: int, w: int, div: int = 8, min_size: int = 128
+) -> Tuple[int, int]:
+    """The padded (H, W) grid RAFT actually runs at for an (h, w) input:
+    /``div`` multiples (the encoder downsamples 1/8) with a ``min_size``
+    floor per dim — the deepest of the 4 correlation-pyramid levels lives
+    at 1/64 resolution and the pixel-coordinate sampler needs every level
+    at least 2 wide. This is InputPadder's target geometry
+    (extract_raft.py) and the output contract the shape-contracted
+    ``--preprocess device`` taps resize onto directly."""
+    return max(-(-h // div) * div, min_size), max(-(-w // div) * div, min_size)
+
+
 def build(iters: int = 20, dtype=jnp.float32) -> RAFT:
     return RAFT(iters=iters, dtype=dtype)
 

@@ -129,6 +129,38 @@ def _banded_resample(x, wt, idx, axis: int):
     return y
 
 
+def device_resize_frames(
+    frames: jnp.ndarray,
+    wy: Tuple[jnp.ndarray, jnp.ndarray],
+    wx: Tuple[jnp.ndarray, jnp.ndarray],
+) -> jnp.ndarray:
+    """The resample core of ``--preprocess device``, without the
+    normalize/transpose tail: raw uint8 HWC frames -> two banded separable
+    passes against host-built PIL-semantics taps -> float32 HWC in
+    [0, 255]. This is the piece every shape-contracted consumer shares —
+    the flow models want [0, 255] channels-last input (RAFT/PWC apply
+    their own scaling in-model) and I3D's chains start from [0, 255] —
+    while CLIP/ResNet layer the mean/std normalize on top
+    (:func:`device_preprocess_frames`).
+
+    PIL runs horizontal-first and rounds+clips to uint8 between the
+    passes and after the last one; that quantization is replayed here
+    (load-bearing under bicubic overshoot, and the identity on the
+    integer-valued outputs of identity taps, so no-resize contracts stay
+    bit-exact). Tap layouts as documented on
+    :func:`device_preprocess_frames`."""
+    wt_y, idx_y = wy
+    wt_x, idx_x = wx
+
+    def quant8(v):  # PIL's inter-pass uint8 round+clamp, kept as float
+        return jnp.clip(jnp.round(v), 0.0, 255.0)
+
+    # horizontal first (W axis), then vertical (H axis) — PIL's order
+    w_axis = frames.ndim - 2
+    y = quant8(_banded_resample(frames, wt_x, idx_x, axis=w_axis))
+    return quant8(_banded_resample(y, wt_y, idx_y, axis=w_axis - 1))
+
+
 def device_preprocess_frames(
     frames: jnp.ndarray,
     wy: Tuple[jnp.ndarray, jnp.ndarray],
@@ -167,16 +199,7 @@ def device_preprocess_frames(
         taps (rows from different videos concatenated, ResNet
         aggregation)
     """
-    wt_y, idx_y = wy
-    wt_x, idx_x = wx
-
-    def quant8(v):  # PIL's inter-pass uint8 round+clamp, kept as float
-        return jnp.clip(jnp.round(v), 0.0, 255.0)
-
-    # horizontal first (W axis), then vertical (H axis) — PIL's order
-    w_axis = frames.ndim - 2
-    y = quant8(_banded_resample(frames, wt_x, idx_x, axis=w_axis))
-    y = quant8(_banded_resample(y, wt_y, idx_y, axis=w_axis - 1))
+    y = device_resize_frames(frames, wy, wx)
     # (..., P, Q, C) -> (..., C, P, Q)
     perm = tuple(range(y.ndim - 3)) + (y.ndim - 1, y.ndim - 3, y.ndim - 2)
     y = jnp.transpose(y, perm)
@@ -192,6 +215,20 @@ def tensor_center_crop(x: jnp.ndarray, crop: int) -> jnp.ndarray:
     fh = (H - crop) // 2
     fw = (W - crop) // 2
     return x[..., fh : fh + crop, fw : fw + crop]
+
+
+def dynamic_center_crop(x: jnp.ndarray, top, left, crop: int) -> jnp.ndarray:
+    """Crop ``crop`` x ``crop`` out of the (..., H, W, C) axes at a
+    TRACED (top, left) offset. Under the shape-contracted I3D flow path
+    the crop window's position inside the padded output bucket varies per
+    source resolution while the executable is shared per bucket, so the
+    offsets ship as jit inputs (int32 scalars) and the slice is a
+    ``dynamic_slice`` — one compile per bucket instead of one per
+    source shape."""
+    import jax.lax
+
+    x = jax.lax.dynamic_slice_in_dim(x, top, crop, axis=x.ndim - 3)
+    return jax.lax.dynamic_slice_in_dim(x, left, crop, axis=x.ndim - 2)
 
 
 def scale_to_1_1(x: jnp.ndarray) -> jnp.ndarray:

@@ -164,14 +164,18 @@ def resize_bicubic(x: jnp.ndarray, size) -> jnp.ndarray:
     return resize_pil(x, size, method="bicubic")
 
 
-def resized_hw(h: int, w: int, size: int) -> Tuple[int, int]:
-    """The (oh, ow) PIL's smaller-edge resize produces, mirroring
+def resized_hw(
+    h: int, w: int, size: int, smaller_edge: bool = True
+) -> Tuple[int, int]:
+    """The (oh, ow) PIL's aspect-keeping resize produces, mirroring
     ops/preprocess.py::pil_resize exactly — including the early return
     when the smaller edge already equals ``size`` (no resize at all, even
-    if the larger edge differs)."""
+    if the larger edge differs; the quirk fires in both edge modes).
+    ``smaller_edge=False`` matches ``resize_to_smaller_edge=False`` (the
+    flow extractors' ``--side_size`` larger-edge mode)."""
     if (w <= h and w == size) or (h <= w and h == size):
         return h, w
-    if w < h:
+    if (w < h) == smaller_edge:
         return int(size * h / w), size
     return size, int(size * w / h)
 
@@ -185,6 +189,7 @@ def fused_resize_crop_matrices(
     method: str = "bicubic",
     pad_h: Optional[int] = None,
     pad_w: Optional[int] = None,
+    crop_offset: str = "round",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(Wy (crop, pad_h or h), Wx (crop, pad_w or w)) float32 matrices
     composing PIL smaller-edge resize to ``resize_to`` with torchvision
@@ -195,17 +200,29 @@ def fused_resize_crop_matrices(
     ``pil_center_crop``'s zero padding), and source columns beyond
     (h, w) — the ``spatial_bucket`` padding — carry zero weight too, so
     bucket pad pixels cannot bleed into the output. Cached per source
-    resolution: a corpus re-uses each (h, w)'s matrices across videos."""
+    resolution: a corpus re-uses each (h, w)'s matrices across videos.
+
+    ``crop_offset`` picks the center-offset convention: ``"round"`` is
+    torchvision CenterCrop (round half to even), ``"floor"`` is the I3D
+    chain's tensor crop (``(size - crop) // 2``,
+    models/i3d/extract_i3d.py::center_crop) — they differ by one source
+    row/col whenever the resized edge parity is odd."""
     oh, ow = resized_hw(h, w, resize_to)
     ry = resample_matrix(h, oh, method)
     rx = resample_matrix(w, ow, method)
-    # torchvision CenterCrop offsets (round half to even); when the
-    # resized image is smaller than the crop, pil_center_crop zero-pads
-    # with a floor-divided top/left margin BEFORE cropping — mirror that
-    # as a negative offset so the zero rows land where PIL's pad does
+    # torchvision CenterCrop offsets (round half to even) or the I3D
+    # tensor-crop floor; when the resized image is smaller than the crop,
+    # pil_center_crop zero-pads with a floor-divided top/left margin
+    # BEFORE cropping — mirror that as a negative offset so the zero rows
+    # land where PIL's pad does
+    if crop_offset not in ("round", "floor"):
+        raise ValueError(f"unknown crop_offset policy: {crop_offset!r}")
+
     def _offset(size_: int) -> int:
         if size_ < crop:
             return -((crop - size_) // 2)
+        if crop_offset == "floor":
+            return (size_ - crop) // 2
         return int(round((size_ - crop) / 2.0))
 
     top = _offset(oh)
@@ -262,6 +279,7 @@ def fused_resize_crop_banded(
     method: str = "bicubic",
     pad_h: Optional[int] = None,
     pad_w: Optional[int] = None,
+    crop_offset: str = "round",
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """``fused_resize_crop_matrices`` in banded form: (wt_y, idx_y, wt_x,
     idx_x). K is computed at the BUCKET resolution (pad_h, pad_w), not the
@@ -270,7 +288,9 @@ def fused_resize_crop_banded(
     resolution sharing a bucket pads up to one static K — mixed-resolution
     ``--video_batch`` groups can stack their taps, and one executable
     serves the whole bucket."""
-    wy, wx = fused_resize_crop_matrices(h, w, resize_to, crop, method, pad_h, pad_w)
+    wy, wx = fused_resize_crop_matrices(
+        h, w, resize_to, crop, method, pad_h, pad_w, crop_offset
+    )
     bh, bw = pad_h or h, pad_w or w
     # analytic K bound from the bucket's worst-case scale: a resample row
     # holds hi-lo taps with hi-lo <= floor(2*support*fscale)+1, and within
@@ -283,6 +303,115 @@ def fused_resize_crop_banded(
     # min-edge lands exactly on resize_to takes pil_resize's no-op early
     # return, K=1, while its neighbors still resize.)
     smax = max(min(bh, bw) / float(resize_to), 1.0)
+    k = int(2 * _SUPPORT[method] * smax) + 2
+    wt_y, idx_y = banded(wy, k)
+    wt_x, idx_x = banded(wx, k)
+    if wt_y.shape[1] != k or wt_x.shape[1] != k:
+        raise AssertionError(
+            f"band width escaped its bucket bound: {wt_y.shape[1]}/"
+            f"{wt_x.shape[1]} vs {k} for {(h, w)} in {(bh, bw)}"
+        )
+    return wt_y, idx_y, wt_x, idx_x
+
+
+# --- shape-contracted outputs (flow + I3D device preprocess) ---------------
+
+@lru_cache(maxsize=256)
+def shape_contract_matrices(
+    h: int,
+    w: int,
+    resize_to: int,
+    out_h: int,
+    out_w: int,
+    top: int = 0,
+    left: int = 0,
+    method: str = "bilinear",
+    pad_h: Optional[int] = None,
+    pad_w: Optional[int] = None,
+    pad_mode: str = "edge",
+    smaller_edge: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The crop-free generalization of ``fused_resize_crop_matrices``:
+    (Wy (out_h, pad_h or h), Wx (out_w, pad_w or w)) matrices that resize
+    a source frame onto an agreed **output contract** — a fixed
+    (out_h, out_w) grid with the resized (oh, ow) image placed at
+    (top, left). That is exactly the geometry the flow models and I3D
+    need: their host chains resize to a shape that VARIES with the source
+    (min-edge-256 for I3D, ``--side_size`` or no resize for RAFT/PWC) and
+    then replicate-pad to the model's /8 or /64 grid; here pad and resize
+    collapse into one tap set per source resolution.
+
+    ``resize_to`` = 0 skips the resize (identity taps — the no
+    ``--side_size`` flow case); otherwise it is PIL's aspect-keeping edge
+    resize (``smaller_edge`` as in ``pil_resize``). ``pad_mode`` places
+    the out-of-image rows/cols: ``"edge"`` repeats the nearest image
+    row/col's taps — composing the resize with ``np.pad(mode="edge")``
+    (InputPadder's replicate pad) into the same matrix, exact because the
+    pad copies already-quantized pixels; ``"zero"`` leaves them at zero
+    weight. Source columns beyond (h, w) — input ``spatial_bucket``
+    padding — always carry zero weight."""
+    if pad_mode not in ("edge", "zero"):
+        raise ValueError(f"unknown pad_mode: {pad_mode!r}")
+    oh, ow = resized_hw(h, w, resize_to, smaller_edge) if resize_to else (h, w)
+    if not (0 <= top and top + oh <= out_h and 0 <= left and left + ow <= out_w):
+        raise ValueError(
+            f"resized image {(oh, ow)} at offset {(top, left)} does not fit "
+            f"the {(out_h, out_w)} output contract"
+        )
+    ry = resample_matrix(h, oh, method)
+    rx = resample_matrix(w, ow, method)
+    wy = np.zeros((out_h, pad_h or h), np.float32)
+    wx = np.zeros((out_w, pad_w or w), np.float32)
+    for out_r in range(out_h):
+        r = out_r - top
+        if pad_mode == "edge":
+            r = min(max(r, 0), oh - 1)
+        if 0 <= r < oh:
+            wy[out_r, :h] = ry[r]
+    for out_c in range(out_w):
+        c = out_c - left
+        if pad_mode == "edge":
+            c = min(max(c, 0), ow - 1)
+        if 0 <= c < ow:
+            wx[out_c, :w] = rx[c]
+    wy.setflags(write=False)
+    wx.setflags(write=False)
+    return wy, wx
+
+
+@lru_cache(maxsize=256)
+def shape_contract_banded(
+    h: int,
+    w: int,
+    resize_to: int,
+    out_h: int,
+    out_w: int,
+    top: int = 0,
+    left: int = 0,
+    method: str = "bilinear",
+    pad_h: Optional[int] = None,
+    pad_w: Optional[int] = None,
+    pad_mode: str = "edge",
+    smaller_edge: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """``shape_contract_matrices`` in banded form (wt_y, idx_y, wt_x,
+    idx_x), with K bounded analytically from the input bucket exactly as
+    ``fused_resize_crop_banded`` does — every source resolution sharing
+    an (input bucket, output contract) pair pads to one K, so taps stack
+    across a ``--video_batch`` group and one executable serves the pair.
+    With ``resize_to`` = 0 the taps are the identity band (K covers it
+    trivially), which makes the no-resize flow contract a pure gather —
+    bit-exact against host ``np.pad(mode="edge")``."""
+    wy, wx = shape_contract_matrices(
+        h, w, resize_to, out_h, out_w, top, left,
+        method, pad_h, pad_w, pad_mode, smaller_edge,
+    )
+    bh, bw = pad_h or h, pad_w or w
+    if resize_to:
+        edge = min(bh, bw) if smaller_edge else max(bh, bw)
+        smax = max(edge / float(resize_to), 1.0)
+    else:
+        smax = 1.0
     k = int(2 * _SUPPORT[method] * smax) + 2
     wt_y, idx_y = banded(wy, k)
     wt_x, idx_x = banded(wx, k)

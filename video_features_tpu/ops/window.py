@@ -51,6 +51,28 @@ def spatial_bucket(
     return bucket_size(h, multiple), bucket_size(w, multiple)
 
 
+def flow_output_bucket(
+    oh: int,
+    ow: int,
+    multiple: int = 64,
+    div: int = 8,
+    min_size: int = 128,
+) -> Tuple[int, int]:
+    """Output-side bucket for a shape-contracted flow grid: the resized
+    (oh, ow) first rounds up to the flow model's padded input grid
+    (``/div`` multiples with a ``min_size`` floor — RAFT's InputPadder
+    geometry, models/raft/model.py::input_grid), then up to ``multiple``
+    so a variable-resolution corpus lands on a small set of output
+    contracts. ``multiple=div`` collapses the second rounding: the bucket
+    IS the exact padder grid (the standalone-flow case, where exact
+    geometry buys bit parity with host ``InputPadder.pad``). These ids
+    join the aggregation key, so ``--video_batch`` still fuses per
+    (input bucket, output bucket) pair."""
+    tgt_h = max(int(math.ceil(oh / div) * div), min_size)
+    tgt_w = max(int(math.ceil(ow / div) * div), min_size)
+    return bucket_size(tgt_h, multiple), bucket_size(tgt_w, multiple)
+
+
 def pad_hw(x: np.ndarray, to_h: int, to_w: int) -> np.ndarray:
     """Zero-pad the (H, W) axes of (..., H, W, C) frames up to the
     spatial bucket (the uint8-HWC layout the decode path produces)."""
