@@ -922,6 +922,46 @@ def _sub_flow_e2e() -> dict:
     return out
 
 
+def _sub_fault_overhead() -> dict:
+    """Happy-path cost of the fault-tolerance bookkeeping (runtime/
+    faults.py): per video the pipeline adds four ``faults.fire()`` no-op
+    checks (decode/prepare/dispatch/sink stages) plus one manifest 'done'
+    record (a flushed JSONL append). Reported in us/video and as a
+    percentage of the r01 CLIP headline (3.637 videos/s on the real chip
+    -> ~275 ms/video), pinning the <1% budget from ISSUE 3."""
+    import timeit
+
+    from video_features_tpu.runtime import faults
+
+    n = 2000
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        faults.install_injector(None)  # the happy path: no injector at all
+        man = faults.RunManifest(tmp)
+        seq = iter(range(n * 2))
+
+        def one_video():
+            faults.fire("decode")
+            faults.fire("prepare")
+            faults.fire("dispatch")
+            faults.fire("sink")
+            man.record(f"/videos/{next(seq)}.mp4", "done", attempts=1, wall_s=0.25)
+
+        total_s = timeit.timeit(one_video, number=n)
+        t0 = time.perf_counter()
+        summary = faults.merge_manifest(tmp)
+        merge_s = time.perf_counter() - t0
+        per_video_us = total_s / n * 1e6
+        headline_s_per_video = 1.0 / 3.637  # BENCH_r01 chip headline
+        out["fault_bookkeeping_us_per_video"] = round(per_video_us, 2)
+        out["fault_overhead_pct_vs_headline"] = round(
+            per_video_us / 1e6 / headline_s_per_video * 100.0, 4
+        )
+        out["fault_manifest_merge_s_per_2k_videos"] = round(merge_s, 4)
+        out["fault_manifest_merged_total"] = summary["total"] if summary else 0
+    return out
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -936,6 +976,7 @@ SUB_PARTS = {
     "flow_e2e": _sub_flow_e2e,
     "pallas_corr": lambda: bench_pallas_corr(),
     "flash_attention": lambda: bench_flash_attention(),
+    "fault_overhead": _sub_fault_overhead,
 }
 
 
@@ -1098,6 +1139,10 @@ def main() -> None:
     extra.setdefault("host_pipeline", {}).update(
         _spawn_sub("device_preprocess", 600.0, env={"JAX_PLATFORMS": "cpu"})
     )
+    emit()
+    # pure-host like the pipeline part: the fault-tolerance bookkeeping
+    # cost (fire() no-ops + manifest appends) vs the chip headline
+    extra.update(_spawn_sub("fault_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
 
     if not _probe_backend(fatal=False):

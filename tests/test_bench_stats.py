@@ -158,12 +158,17 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "i3d_agg": {"i3d_agg_vps": 0.5},
         "i3d_device_only": {"i3d_raft_device_only_sps": 0.6},
     }
-    # device_preprocess is the CPU-pinned child folded into host_pipeline,
-    # not a top-level part — stub it apart from stub_results
+    # device_preprocess / fault_overhead are the CPU-pinned children run
+    # in the host-only section, not top-level parts — stub them apart
+    # from stub_results
+    cpu_pinned = {
+        "device_preprocess": {"device_preprocess_fps": 11.0},
+        "fault_overhead": {"fault_bookkeeping_us_per_video": 12.0},
+    }
     monkeypatch.setattr(
         bench, "_spawn_sub",
-        lambda name, timeout, **kw: ({"device_preprocess_fps": 11.0}
-                                     if name == "device_preprocess"
+        lambda name, timeout, **kw: (dict(cpu_pinned[name])
+                                     if name in cpu_pinned
                                      else dict(stub_results[name])))
     monkeypatch.setattr(bench, "bench_host_pipeline",
                         lambda: {"host_pipeline": {"host_decode_cv2_fps": 1.0}})
@@ -187,6 +192,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         for key, val in part.items():
             assert final["extra"][key] == val
     assert final["extra"]["host_pipeline"]["device_preprocess_fps"] == 11.0
+    assert final["extra"]["fault_bookkeeping_us_per_video"] == 12.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -214,6 +220,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
     def boom(name, timeout, **kw):  # no device part may run on a dead backend
         if name == "device_preprocess":  # JAX_PLATFORMS=cpu pinned: tunnel-safe
             return {"device_preprocess_fps": 7.0}
+        if name == "fault_overhead":  # likewise CPU-pinned, host-only section
+            return {"fault_bookkeeping_us_per_video": 12.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

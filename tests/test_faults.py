@@ -1,0 +1,565 @@
+"""Fault tolerance: classification, retries, degradation, manifest.
+
+Every path ISSUE 3 promises is exercised here on CPU via the
+deterministic ``--fault_inject`` hook (runtime/faults.py): decode
+error/hang, prepare failure, simulated-OOM fused dispatch, sink kill —
+classified, retried per policy, and either recovered or recorded failed;
+plus the ``--resume`` contract over the resulting manifest. A toy
+extractor keeps the loop mechanics fast; one test drives the real CLIP
+CLI for the ``--strict`` exit contract.
+"""
+
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig, sanity_check
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import stream_frames
+from video_features_tpu.runtime import faults
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_fault_state():
+    """The injector and decode deadline are process-global (installed by
+    each extractor's __init__); never leak one test's faults into the
+    rest of the suite."""
+    yield
+    faults.install_injector(None)
+    from video_features_tpu.io.video import set_decode_timeout
+
+    set_decode_timeout(None)
+
+
+@pytest.fixture(scope="module")
+def toy_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    d = tmp_path_factory.mktemp("toy_media")
+    return [
+        synth_video(str(d / f"v{i}.mp4"), n_frames=10, width=64, height=48, seed=i)
+        for i in range(4)
+    ]
+
+
+class ToyExtractor(BaseExtractor):
+    """Minimal prepare/extract_prepared extractor: per-frame means. One
+    real decode (one _Reader open => one 'decode' injection call) per
+    prepare; trivial compute; the real sink."""
+
+    feature_type = "toy"
+
+    def _build(self, device):
+        return {"device": device}
+
+    def prepare(self, path_entry):
+        vals = [float(frame.mean()) for frame, _ in stream_frames(video_path_of(path_entry))]
+        return np.asarray(vals, dtype=np.float32)
+
+    def extract_prepared(self, device, state, path_entry, payload):
+        return {
+            "toy": np.asarray(payload).reshape(-1, 1),
+            "fps": 25.0,
+            "timestamps_ms": np.arange(len(payload), dtype=np.float64),
+        }
+
+
+class ToyAgg(ToyExtractor):
+    """Adds the --video_batch aggregation protocol (same-shape payloads
+    fuse; the fused dispatch is where the OOM injection lands)."""
+
+    def agg_key(self, payload):
+        return np.asarray(payload).shape
+
+    def dispatch_group(self, device, state, entries, payloads):
+        return [
+            ToyExtractor.extract_prepared(self, device, state, e, p)
+            for e, p in zip(entries, payloads)
+        ]
+
+    def fetch_group(self, handle):
+        return handle
+
+
+class DevToy(ToyExtractor):
+    """Models --preprocess device: prepare returns a tagged device
+    payload whose dispatch always dies with a compile-marker error, so
+    the device->host fallback (re-prepare with the thread-local
+    force-host flag) is the only road to 'done'."""
+
+    def prepare(self, path_entry):
+        base = super().prepare(path_entry)
+        if self._device_preprocess_enabled():
+            return ("device-payload", base)
+        return base
+
+    def extract_prepared(self, device, state, path_entry, payload):
+        if isinstance(payload, tuple):
+            raise RuntimeError("Mosaic lowering failed for fused preprocess program")
+        return super().extract_prepared(device, state, path_entry, payload)
+
+
+def _cfg(videos, tmp_path, **kw):
+    kw.setdefault("decode_workers", 1)  # serial prep order => deterministic injection counters
+    kw.setdefault("retry_backoff", 0.01)
+    return ExtractionConfig(
+        allow_random_init=True,
+        video_paths=list(videos),
+        on_extraction="save_numpy",
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+        **kw,
+    )
+
+
+def _summary(cfg):
+    s = faults.finalize_run(cfg.output_path)
+    assert s is not None
+    return s
+
+
+# --- classification / policy units ------------------------------------------
+
+
+def test_classification_buckets():
+    assert faults.classify_error(faults.CorruptVideoError("bad bytes")) == "permanent"
+    assert faults.classify_error(faults.DecodeTimeout("stall")) == "transient"
+    assert faults.classify_error(OSError("io flake")) == "transient"
+    assert faults.classify_error(TimeoutError("t")) == "transient"
+    assert faults.classify_error(MemoryError()) == "oom"
+    assert faults.classify_error(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == "oom"
+    assert faults.classify_error(RuntimeError("error during lowering")) == "compile"
+    assert faults.classify_error(ValueError("shape mismatch")) == "permanent"
+    # corrupt IS an OSError subclass but must not take the transient rule
+    assert issubclass(faults.CorruptVideoError, IOError)
+    assert faults.is_retryable("transient") and faults.is_retryable("oom")
+    assert not faults.is_retryable("compile") and not faults.is_retryable("permanent")
+
+
+def test_backoff_deterministic_and_exponential():
+    a1 = faults.backoff_delay(1, 0.5, "/v/a.mp4")
+    assert a1 == faults.backoff_delay(1, 0.5, "/v/a.mp4")
+    assert 0.25 <= a1 <= 0.5
+    assert 0.5 <= faults.backoff_delay(2, 0.5, "/v/a.mp4") <= 1.0
+    assert faults.backoff_delay(3, 0.0, "k") == 0.0
+    # jitter desynchronizes different videos
+    keys = [f"/v/{i}.mp4" for i in range(16)]
+    assert len({faults.backoff_delay(1, 0.5, k) for k in keys}) > 4
+
+
+def test_fault_spec_validation():
+    specs = faults.parse_fault_specs(["decode:hang:2", "sink:kill:1"])
+    assert specs[0] == faults.FaultSpec("decode", "hang", 2)
+    for bad in ("decode:error", "warp:error:1", "decode:melt:1", "decode:error:0"):
+        with pytest.raises(ValueError, match="fault_inject"):
+            faults.parse_fault_specs([bad])
+    with pytest.raises(ValueError, match="fault_inject"):
+        sanity_check(ExtractionConfig(fault_inject=["decode:error:nope"]))
+    with pytest.raises(ValueError, match="retry_failed"):
+        sanity_check(ExtractionConfig(retry_failed=True))
+    with pytest.raises(ValueError, match="retries"):
+        sanity_check(ExtractionConfig(retries=-1))
+    with pytest.raises(ValueError, match="decode_timeout"):
+        sanity_check(ExtractionConfig(decode_timeout=0.0))
+
+
+def test_manifest_merge_last_terminal_wins(tmp_path):
+    m = faults.RunManifest(str(tmp_path))
+    m.record("/v/a.mp4", "retry", stage="decode", error_class="transient", attempts=1)
+    m.record("/v/a.mp4", "done", attempts=2)
+    m.record("/v/b.mp4", "failed", stage="prepare", error_class="permanent")
+    s = faults.merge_manifest(str(tmp_path))
+    assert s["videos"]["/v/a.mp4"]["status"] == "done"
+    assert s["videos"]["/v/a.mp4"]["attempts"] == 2
+    assert s["retries"] == 1 and s["failed"] == 1
+    # a later resume run's 'skipped' probe must never demote a 'done'
+    m2 = faults.RunManifest(str(tmp_path))
+    m2.record("/v/a.mp4", "skipped", message="outputs exist")
+    s2 = faults.merge_manifest(str(tmp_path))
+    assert s2["videos"]["/v/a.mp4"]["status"] == "done"
+    assert faults.permanently_failed_videos(str(tmp_path)) == {"/v/b.mp4"}
+
+
+def test_strict_failures_cover_warnings_and_deaths(tmp_path):
+    m = faults.RunManifest(str(tmp_path))
+    m.record("/v/a.mp4", "done", attempts=1)
+    m.record("/v/a.mp4", "warning", stage="sink", message="the value is empty for toy")
+    m.event("worker_death", device="cpu:0", error_type="RuntimeError", message="boom")
+    s = faults.finalize_run(str(tmp_path))
+    probs = faults.strict_failures(s)
+    assert len(probs) == 2
+    assert any("empty" in p for p in probs) and any("worker death" in p for p in probs)
+
+
+# --- injected faults through the real extractor loop ------------------------
+
+
+def test_decode_error_retries_and_recovers(toy_videos, tmp_path, capsys):
+    # decode call 3 (third reader open) fires: v2's first attempt fails
+    # transient, its retry (call 4) succeeds
+    cfg = _cfg(toy_videos[:3], tmp_path, retries=1, fault_inject=["decode:error:3"])
+    ToyExtractor(cfg)()
+    s = _summary(cfg)
+    assert s["done"] == 3 and s["failed"] == 0 and s["retries"] == 1
+    v2 = s["videos"][toy_videos[2]]
+    assert v2["status"] == "done" and v2["attempts"] == 2
+    assert "retrying in" in capsys.readouterr().out
+    assert len(glob.glob(os.path.join(cfg.output_path, "toy", "*.npy"))) == 3
+
+
+def test_decode_hang_hits_deadline_and_exhausts_retries(toy_videos, tmp_path, capsys):
+    # every reader open hangs HANG_SECONDS=0.4 > the 0.1 s deadline: the
+    # REAL DecodeTimeout fires on the next grab(), each retry re-hangs,
+    # and the video is recorded failed-transient after the budget.
+    # One video => the serial loop, so both loops' retry paths get covered.
+    cfg = _cfg(
+        toy_videos[:1],
+        tmp_path,
+        retries=1,
+        decode_timeout=0.1,
+        fault_inject=["decode:hang:1"],
+    )
+    ToyExtractor(cfg)()
+    s = _summary(cfg)
+    assert s["failed"] == 1 and s["retries"] == 1
+    rec = s["videos"][toy_videos[0]]
+    assert rec["status"] == "failed"
+    assert rec["error_type"] == "DecodeTimeout"
+    assert rec["error_class"] == "transient"
+    assert rec["stage"] == "decode"
+    assert rec["attempts"] == 2
+    assert "An error occurred" in capsys.readouterr().out
+
+
+def test_corrupt_video_fails_fast_no_retry(toy_videos, tmp_path, capsys):
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"not a video at all")
+    cfg = _cfg([toy_videos[0], str(bad)], tmp_path, retries=2)
+    ToyExtractor(cfg)()
+    s = _summary(cfg)
+    assert s["done"] == 1 and s["failed"] == 1 and s["retries"] == 0
+    rec = s["videos"][str(bad)]
+    assert rec["error_class"] == "permanent" and rec["attempts"] == 1
+    assert rec["error_type"] == "CorruptVideoError"
+    out = capsys.readouterr().out
+    assert out.count("An error occurred") == 1
+
+
+def test_injected_prepare_permanent_fails_fast(toy_videos, tmp_path):
+    # prepare call 2 (v1) raises the unfixable kind: no retry records
+    cfg = _cfg(toy_videos[:3], tmp_path, retries=2, fault_inject=["prepare:corrupt:2"])
+    ToyExtractor(cfg)()
+    s = _summary(cfg)
+    assert s["done"] == 2 and s["failed"] == 1 and s["retries"] == 0
+    rec = s["videos"][toy_videos[1]]
+    assert rec["error_class"] == "permanent" and rec["stage"] == "prepare"
+
+
+def test_group_oom_dispatch_splits_and_recovers(toy_videos, tmp_path, capsys):
+    # EVERY fused dispatch OOMs; the solo fallback re-runs members with
+    # injection suppressed, so all four videos recover individually
+    cfg = _cfg(
+        toy_videos,
+        tmp_path,
+        video_batch=2,
+        retries=0,
+        fault_inject=["dispatch:oom:1"],
+    )
+    ToyAgg(cfg)()
+    s = _summary(cfg)
+    assert s["done"] == 4 and s["failed"] == 0
+    falls = [e for e in s["events"] if e.get("event") == "group_fallback"]
+    assert len(falls) == 2 and all(f["size"] == 2 for f in falls)
+    out = capsys.readouterr().out
+    assert out.count("Fused --video_batch dispatch failed") == 2
+    assert "An error occurred" not in out
+    assert len(glob.glob(os.path.join(cfg.output_path, "toy", "*.npy"))) == 4
+
+
+def test_sink_kill_is_atomic_and_resume_retries(toy_videos, tmp_path):
+    # killed between tmp write and rename: nothing the resume probe
+    # trusts may exist (ISSUE 3 satellite: atomic-write + --resume)
+    cfg = _cfg(toy_videos[:2], tmp_path, retries=0, fault_inject=["sink:kill:1"])
+    ToyExtractor(cfg)()
+    s = _summary(cfg)
+    assert s["failed"] == 2
+    assert all(v["stage"] == "sink" for v in s["videos"].values())
+    feat_dir = os.path.join(cfg.output_path, "toy")
+    assert glob.glob(os.path.join(feat_dir, "*.npy")) == []
+    assert glob.glob(os.path.join(feat_dir, "*.tmp*")) == []
+    # second invocation: --resume --retry_failed re-attempts (the kill is
+    # classified permanent) with no injection -> completes the run
+    cfg2 = _cfg(toy_videos[:2], tmp_path, resume=True, retry_failed=True)
+    ToyExtractor(cfg2)()
+    s2 = _summary(cfg2)
+    assert s2["done"] == 2 and s2["failed"] == 0
+    assert len(glob.glob(os.path.join(feat_dir, "*.npy"))) == 2
+
+
+def test_resume_skips_permanent_failures_unless_retry_failed(toy_videos, tmp_path):
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"junk")
+    videos = [toy_videos[0], str(bad)]
+    cfg = _cfg(videos, tmp_path)
+    ToyExtractor(cfg)()
+    assert _summary(cfg)["failed"] == 1
+    # resume: the permanent failure is skipped, not re-decoded
+    cfg2 = _cfg(videos, tmp_path, resume=True)
+    ex2 = ToyExtractor(cfg2)
+    assert str(bad) in ex2._prior_failed
+    ex2()
+    s2 = _summary(cfg2)
+    assert s2["videos"][str(bad)]["status"] == "failed"  # skip never demotes
+    records = [
+        r
+        for r in faults.iter_manifest_records(cfg2.output_path)
+        if r.get("video") == str(bad) and r.get("status") == "skipped"
+    ]
+    assert records and "permanent failure" in records[-1]["message"]
+    # --retry_failed: re-attempted (and fails again — the bytes are junk)
+    cfg3 = _cfg(videos, tmp_path, resume=True, retry_failed=True)
+    ex3 = ToyExtractor(cfg3)
+    assert ex3._prior_failed == set()
+    ex3()
+    attempts = [
+        r
+        for r in faults.iter_manifest_records(cfg3.output_path)
+        if r.get("video") == str(bad) and r.get("status") == "failed"
+    ]
+    assert len(attempts) == 2
+
+
+def test_device_preprocess_falls_back_to_host(toy_videos, tmp_path, capsys):
+    cfg = _cfg(toy_videos[:2], tmp_path, preprocess="device", retries=0)
+    DevToy(cfg)()
+    s = _summary(cfg)
+    assert s["failed"] == 0 and s["done"] == 2
+    for v in toy_videos[:2]:
+        assert s["videos"][v]["status"] == "done"
+    fallbacks = [
+        r
+        for r in faults.iter_manifest_records(cfg.output_path)
+        if r.get("status") == "fallback"
+    ]
+    assert len(fallbacks) == 2
+    assert all(r["error_class"] == "compile" for r in fallbacks)
+    done_notes = [
+        r.get("note")
+        for r in faults.iter_manifest_records(cfg.output_path)
+        if r.get("status") == "done"
+    ]
+    assert done_notes.count("device->host preprocess fallback") == 2
+    assert "falling back to the host chain" in capsys.readouterr().out
+    assert len(glob.glob(os.path.join(cfg.output_path, "toy", "*.npy"))) == 2
+
+
+def test_output_direct_resume_probes_collapsed_name(toy_videos, tmp_path):
+    cfg = _cfg(toy_videos[:1], tmp_path, output_direct=True)
+    ToyExtractor(cfg)()
+    stem = os.path.splitext(os.path.basename(toy_videos[0]))[0]
+    assert os.path.exists(os.path.join(cfg.output_path, f"{stem}.npy"))
+    cfg2 = _cfg(toy_videos[:1], tmp_path, output_direct=True, resume=True)
+    ToyExtractor(cfg2)()
+    skips = [
+        r
+        for r in faults.iter_manifest_records(cfg2.output_path)
+        if r.get("status") == "skipped"
+    ]
+    assert skips and skips[-1]["message"] == "outputs exist"
+
+
+def test_empty_feature_recorded_as_manifest_warning(toy_videos, tmp_path):
+    class EmptyToy(ToyExtractor):
+        def extract_prepared(self, device, state, path_entry, payload):
+            d = super().extract_prepared(device, state, path_entry, payload)
+            d["toy"] = np.zeros((0, 1), dtype=np.float32)
+            return d
+
+    cfg = _cfg(toy_videos[:1], tmp_path)
+    EmptyToy(cfg)()
+    s = _summary(cfg)
+    assert s["done"] == 1
+    assert len(s["warnings"]) == 1 and "empty" in s["warnings"][0]["message"]
+    assert faults.strict_failures(s)  # --strict would fail the run on it
+
+
+# --- sink atomicity under concurrency (the tmp-name race satellite) ----------
+
+
+def test_concurrent_sink_threads_do_not_clobber_tmp(tmp_path):
+    from video_features_tpu.io.sink import action_on_extraction
+
+    value = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    errors = []
+
+    def save():
+        try:
+            for _ in range(25):
+                action_on_extraction(
+                    {"toy": value}, "/v/same.mp4", str(tmp_path), "save_numpy"
+                )
+        except BaseException as e:  # noqa: BLE001 - the race under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=save) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    np.testing.assert_array_equal(np.load(tmp_path / "same_toy.npy"), value)
+    assert glob.glob(str(tmp_path / "*.tmp*")) == []
+
+
+# --- scheduler: worker deaths --------------------------------------------
+
+
+class _SchedFake:
+    def __init__(self, n, tmp, retries=2, die_on=()):
+        from tqdm import tqdm
+
+        self.config = ExtractionConfig(allow_random_init=True, retries=retries)
+        self.path_list = [f"/v/{i}.mp4" for i in range(n)]
+        self.progress = tqdm(total=n, disable=True)
+        self.manifest = faults.RunManifest(str(tmp))
+        self.die_on = set(die_on)
+        self.done = []
+
+    def warmup(self, device):
+        return {}
+
+    def _video_key(self, entry):
+        return str(entry)
+
+    def __call__(self, chunk, device=None):
+        if device in self.die_on:
+            raise RuntimeError(f"hbm fault on {device}")
+        self.done.extend(chunk)
+        for _ in chunk:
+            self.progress.update()
+
+
+def test_all_dead_error_summarizes_every_death(tmp_path):
+    from video_features_tpu.parallel.scheduler import parallel_feature_extraction
+
+    fake = _SchedFake(6, tmp_path, die_on={"devA", "devB"})
+    with pytest.raises(RuntimeError, match="unprocessed") as ei:
+        parallel_feature_extraction(fake, devices=["devA", "devB"])
+    msg = str(ei.value)
+    assert "devA" in msg and "devB" in msg and "2 worker death(s)" in msg
+    deaths = [
+        e
+        for e in faults.iter_manifest_records(str(tmp_path))
+        if e.get("event") == "worker_death"
+    ]
+    assert len(deaths) == 2
+    assert all(d["error_type"] == "RuntimeError" for d in deaths)
+
+
+def test_worker_death_requeue_cap_records_failed(tmp_path):
+    from video_features_tpu.parallel.scheduler import parallel_feature_extraction
+
+    # retries=0: the dying worker's chunk is dropped + recorded instead
+    # of ping-ponging, and the run completes without raising
+    fake = _SchedFake(4, tmp_path, retries=0, die_on={"devA"})
+    parallel_feature_extraction(fake, devices=["devA"])
+    failed = [
+        r
+        for r in faults.iter_manifest_records(str(tmp_path))
+        if r.get("status") == "failed"
+    ]
+    assert len(failed) == 4
+    assert all(r["stage"] == "worker" for r in failed)
+
+
+# --- subprocess decode deadline ---------------------------------------------
+
+
+def test_subprocess_timeout_becomes_decode_timeout():
+    from video_features_tpu.io.ffmpeg import _run
+
+    with pytest.raises(faults.DecodeTimeout, match="decode_timeout"):
+        _run(["sleep", "5"], timeout_s=0.2)
+
+
+# --- the acceptance matrix: mixed faults, then --resume ----------------------
+
+
+def test_acceptance_faulted_run_then_resume_touches_only_undone(
+    toy_videos, tmp_path
+):
+    # one permanent decode failure (v1 corrupt) + one sink kill (first
+    # sink call = v0): run 1 finishes 2/4, records both failures classified
+    bad = tmp_path / "bad.mp4"
+    bad.write_bytes(b"definitely not mp4")
+    videos = [toy_videos[0], str(bad), toy_videos[2], toy_videos[3]]
+    cfg = _cfg(videos, tmp_path, retries=1, fault_inject=["sink:kill:3"])
+    ToyExtractor(cfg)()
+    s = _summary(cfg)
+    assert s["done"] == 2 and s["failed"] == 2
+    assert s["videos"][str(bad)]["error_class"] == "permanent"
+    killed = [k for k, v in s["videos"].items() if v.get("stage") == "sink"]
+    assert len(killed) == 1
+    feat_dir = os.path.join(cfg.output_path, "toy")
+    done_files = sorted(glob.glob(os.path.join(feat_dir, "*.npy")))
+    assert len(done_files) == 2
+    mtimes = {f: os.path.getmtime(f) for f in done_files}
+
+    # run 2: --resume --retry_failed completes the run touching ONLY the
+    # non-done videos (done outputs' mtimes unchanged; the corrupt one
+    # re-fails — its bytes are still junk)
+    cfg2 = _cfg(videos, tmp_path, resume=True, retry_failed=True)
+    ToyExtractor(cfg2)()
+    s2 = _summary(cfg2)
+    assert s2["done"] == 3 and s2["failed"] == 1
+    assert len(glob.glob(os.path.join(feat_dir, "*.npy"))) == 3
+    for f, t in mtimes.items():
+        assert os.path.getmtime(f) == t, f"resume re-touched a done output: {f}"
+    skipped = [
+        r
+        for r in faults.iter_manifest_records(cfg2.output_path)
+        if r.get("status") == "skipped"
+    ]
+    assert len(skipped) == 2  # both done videos probed + skipped
+
+
+# --- --strict through the real CLI -------------------------------------------
+
+
+def test_strict_exit_nonzero_through_cli(tmp_path, sample_video):
+    from video_features_tpu import cli
+
+    argv = [
+        "--feature_type", "CLIP-ViT-B/32",
+        "--video_paths", sample_video,
+        "--extract_method", "uni_4",
+        "--cpu", "--allow_random_init",
+        "--on_extraction", "save_numpy",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--retries", "0",
+        "--strict",
+        "--fault_inject", "sink:kill:1",
+    ]
+    with pytest.raises(SystemExit, match="--strict"):
+        cli.main(argv)
+    summary = json.load(
+        open(os.path.join(tmp_path, "out", "_manifest", "summary.json"))
+    )
+    assert summary["failed"] == 1
+    # the same run without --strict completes with exit 0 (drop the kill
+    # so the sink succeeds; resume re-attempts the failed video)
+    cli.main([a for a in argv if a not in ("--strict", "--fault_inject", "sink:kill:1")]
+             + ["--resume", "--retry_failed"])
+    summary2 = json.load(
+        open(os.path.join(tmp_path, "out", "_manifest", "summary.json"))
+    )
+    assert summary2["done"] == 1 and summary2["failed"] == 0

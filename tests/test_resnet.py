@@ -221,7 +221,7 @@ def test_fps_retarget_reencode_decodes_the_reencoded_file(sample_video, tmp_path
                            width=96, height=64, seed=123)
     calls = []
 
-    def fake_reencode(video_path, tmp_dir, fps):
+    def fake_reencode(video_path, tmp_dir, fps, timeout_s=None):
         calls.append((video_path, tmp_dir, fps))
         return sentinel
 

@@ -46,10 +46,34 @@ def main(argv=None) -> None:
 
     extractor = build_extractor(cfg)
     devices = resolve_devices(cfg)
-    if cfg.sharding == "mesh":
-        mesh_feature_extraction(extractor, devices)
-    else:
-        parallel_feature_extraction(extractor, devices)
+    try:
+        if cfg.sharding == "mesh":
+            mesh_feature_extraction(extractor, devices)
+        else:
+            parallel_feature_extraction(extractor, devices)
+    finally:
+        # merge every process's JSONL events into _manifest/summary.json
+        # and print the one-line outcome — even when the scheduler raised,
+        # so a crashed run still leaves a machine-readable record of what
+        # completed (docs/robustness.md). Gated on this run actually
+        # recording (print-mode ad-hoc runs have no manifest dir).
+        summary = None
+        if getattr(extractor.manifest, "path", None) is not None:
+            from video_features_tpu.runtime.faults import finalize_run, format_summary
+
+            summary = finalize_run(cfg.output_path)
+            if summary is not None:
+                print(format_summary(summary))
+    if cfg.strict and summary is not None:
+        from video_features_tpu.runtime.faults import strict_failures
+
+        problems = strict_failures(summary)
+        if problems:
+            raise SystemExit(
+                "--strict: run completed with "
+                + f"{len(problems)} problem(s):\n  "
+                + "\n  ".join(problems)
+            )
 
 
 if __name__ == "__main__":

@@ -211,6 +211,34 @@ class ExtractionConfig:
     # written to --compile_cache (jax_persistent_cache_min_compile_time_
     # secs) — keeps trivial compiles from churning the cache dir.
     compile_cache_min_s: float = 1.0
+    # --- fault tolerance (runtime/faults.py; docs/robustness.md) ---
+    # Retry budget for TRANSIENT per-video failures (I/O flakes, decode
+    # deadlines, RESOURCE_EXHAUSTED): the video re-enters the work queue
+    # with exponential backoff + deterministic jitter up to this many
+    # extra attempts. Permanent failures (corrupt container, shape
+    # mismatch) never retry. Also caps how often the queue scheduler
+    # requeues a chunk orphaned by a worker death.
+    retries: int = 2
+    # Base backoff in seconds; attempt k waits base * 2^(k-1) * jitter.
+    retry_backoff: float = 0.5
+    # Any failed video / empty-feature warning / worker death in the run
+    # manifest turns the exit code nonzero (CI and batch schedulers need
+    # "completed" to mean "everything extracted").
+    strict: bool = False
+    # --resume: also re-attempt videos the manifest recorded as
+    # PERMANENTLY failed (by default resume skips them — re-decoding a
+    # corrupt container forever is the failure mode this flag gates).
+    retry_failed: bool = False
+    # Wall-clock budget (seconds) per decode: a reader (or ffmpeg
+    # re-encode) exceeding it raises DecodeTimeout — classified
+    # transient, so the video retries with a fresh deadline. None = off.
+    decode_timeout: Optional[float] = None
+    # Deterministic fault injection, test-only: STAGE:KIND:EVERY_N specs
+    # (stage in decode/prepare/dispatch/sink; kind in error/corrupt/
+    # hang/oom/compile/kill) raise or stall at that stage every N calls,
+    # so the retry/fallback/manifest paths are exercised by fast CPU
+    # tests (tests/test_faults.py).
+    fault_inject: Optional[List[str]] = None
     # 3D-conv lowering for the 3D-conv families, i3d + r21d
     # (common/layers.py::Conv3DCompat):
     #   'auto'       — honor the VFT_CONV3D_IMPL env var, else direct;
@@ -333,6 +361,21 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise ValueError(
             f"compile_cache_min_s must be >= 0, got {cfg.compile_cache_min_s}"
         )
+    if cfg.retries < 0:
+        raise ValueError(f"retries must be >= 0, got {cfg.retries}")
+    if cfg.retry_backoff < 0:
+        raise ValueError(f"retry_backoff must be >= 0, got {cfg.retry_backoff}")
+    if cfg.decode_timeout is not None and cfg.decode_timeout <= 0:
+        raise ValueError(f"decode_timeout must be > 0, got {cfg.decode_timeout}")
+    if cfg.retry_failed and not cfg.resume:
+        raise ValueError(
+            "--retry_failed only modifies --resume (it re-attempts videos "
+            "the manifest recorded as permanently failed); add --resume"
+        )
+    if cfg.fault_inject:
+        from video_features_tpu.runtime.faults import parse_fault_specs
+
+        parse_fault_specs(cfg.fault_inject)  # raises naming the bad spec
     if cfg.mesh_context and cfg.attn != "fused":
         raise ValueError(
             "--mesh_context injects the ring-attention core; it cannot "
@@ -460,6 +503,32 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile_cache_min_s", type=float, default=1.0,
                    help="min compile seconds before an executable is "
                         "written to --compile_cache")
+    p.add_argument("--retries", type=int, default=2,
+                   help="retry budget per video for TRANSIENT failures "
+                        "(I/O flakes, decode deadlines, "
+                        "RESOURCE_EXHAUSTED); backoff is exponential "
+                        "with deterministic jitter")
+    p.add_argument("--retry_backoff", type=float, default=0.5,
+                   help="base retry backoff seconds (attempt k waits "
+                        "base * 2^(k-1) * jitter)")
+    p.add_argument("--strict", action="store_true", default=False,
+                   help="exit nonzero if the run manifest records any "
+                        "failed video, empty-feature warning, or worker "
+                        "death")
+    p.add_argument("--retry_failed", action="store_true", default=False,
+                   help="with --resume: re-attempt videos the manifest "
+                        "recorded as permanently failed (default: skip "
+                        "them)")
+    p.add_argument("--decode_timeout", type=float, default=None,
+                   help="wall-clock seconds per decode before a "
+                        "DecodeTimeout (transient -> retried with a "
+                        "fresh deadline)")
+    p.add_argument("--fault_inject", action="append", default=None,
+                   metavar="STAGE:KIND:EVERY_N",
+                   help="TEST-ONLY deterministic fault injection: raise/"
+                        "stall at STAGE (decode|prepare|dispatch|sink) "
+                        "every N calls; KIND in error|corrupt|hang|oom|"
+                        "compile|kill; repeatable")
     p.add_argument("--mesh_context", action="store_true",
                    help="context parallelism under --sharding mesh: shard "
                         "the transformer token axis over the mesh and run "

@@ -17,7 +17,9 @@ video loop with the same error isolation and sink routing; the
 from __future__ import annotations
 
 import os
+import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -27,6 +29,8 @@ from tqdm import tqdm
 from video_features_tpu.config import as_config
 from video_features_tpu.io.paths import form_list_from_user_input, video_path_of
 from video_features_tpu.io.sink import action_on_extraction, expected_output_files
+from video_features_tpu.runtime import faults
+from video_features_tpu.runtime.faults import NULL_MANIFEST, RunManifest
 from video_features_tpu.utils.profiling import StageTimer, device_trace
 
 
@@ -62,6 +66,37 @@ class BaseExtractor:
         self._device_state: Dict[Any, Any] = {}
         self._build_lock = threading.Lock()
         self.timer = StageTimer()
+        # --- fault tolerance (runtime/faults.py; docs/robustness.md) ---
+        # The manifest roots at config.output_path (NOT the feature-
+        # suffixed dir): one <output>/_manifest covers a multi-feature
+        # output tree, and --resume merges across prior runs. Gated so a
+        # casual print-mode/external run never litters ./output.
+        wants_manifest = not external_call and (
+            self.config.on_extraction in ("save_numpy", "save_pickle")
+            or bool(getattr(self.config, "strict", False))
+            or bool(getattr(self.config, "fault_inject", None))
+        )
+        self.manifest = (
+            RunManifest(self.config.output_path) if wants_manifest else NULL_MANIFEST
+        )
+        faults.install_injector(getattr(self.config, "fault_inject", None))
+        from video_features_tpu.io.video import set_decode_timeout
+
+        set_decode_timeout(getattr(self.config, "decode_timeout", None))
+        self._t0: Dict[str, float] = {}  # video key -> attempt start
+        # --preprocess device degradation: a thread-local force-host flag
+        # lets ONE video's fallback re-prepare through the host chain
+        # while other threads keep the device path
+        self._force_host = threading.local()
+        self._prior_failed: set = set()
+        if (
+            self.config.resume
+            and not external_call
+            and not getattr(self.config, "retry_failed", False)
+        ):
+            self._prior_failed = faults.permanently_failed_videos(
+                self.config.output_path
+            )
 
     def feature_keys(self):
         """The keys a feats_dict will carry (used by --resume to probe for
@@ -84,7 +119,12 @@ class BaseExtractor:
 
             with self.timer.stage("reencode"):
                 return (
-                    reencode_video_with_diff_fps(video_path, self.tmp_path, fps),
+                    reencode_video_with_diff_fps(
+                        video_path,
+                        self.tmp_path,
+                        fps,
+                        timeout_s=getattr(self.config, "decode_timeout", None),
+                    ),
                     None,
                 )
         return video_path, fps
@@ -156,7 +196,14 @@ class BaseExtractor:
         """--preprocess device: the image-model extractors (CLIP, ResNet)
         ship raw uint8 frames and fuse resize/crop/normalize into the
         encoder dispatch (ops/preprocess.py::device_preprocess_frames).
-        sanity_check restricts the flag to the extractors that honor it."""
+        sanity_check restricts the flag to the extractors that honor it.
+
+        False while this thread's ``_force_host`` flag is up: the
+        compile-failure fallback re-prepares ONE video through the host
+        chain (``_run_host_fallback``) without disturbing concurrent
+        device-path prepares."""
+        if getattr(self._force_host, "on", False):
+            return False
         return getattr(self.config, "preprocess", "host") == "device"
 
     # --- per-device model state -------------------------------------------
@@ -208,12 +255,18 @@ class BaseExtractor:
             if self.config.sharding == "mesh" and _jax.process_index() != 0:
                 return
             with self.timer.stage("sink"):
-                action_on_extraction(
+                warnings = action_on_extraction(
                     feats_dict,
                     video_path_of(entry),
                     self.output_path,
                     self.config.on_extraction,
                     self.config.output_direct,
+                )
+            for w in warnings or ():
+                # empty-feature values etc.: recorded so --strict can
+                # fail the run on them (ISSUE 3 satellite)
+                self.manifest.record(
+                    self._video_key(entry), "warning", stage="sink", message=w
                 )
 
     def _report_video_error(self, entry) -> None:
@@ -225,7 +278,9 @@ class BaseExtractor:
         self.progress.update()
 
     def _isolate(self, entry, fn, *args) -> None:
-        """Per-video error isolation (ref extract_clip.py:78-84)."""
+        """Per-video error isolation (ref extract_clip.py:78-84) with no
+        manifest/retry semantics — the legacy contract, kept for callers
+        outside the retrying loops."""
         try:
             fn(*args)
         except KeyboardInterrupt:
@@ -234,6 +289,163 @@ class BaseExtractor:
             self._report_video_error(entry)
             return
         self.progress.update()
+
+    # --- fault-tolerance bookkeeping (runtime/faults.py) -------------------
+    def _video_key(self, entry) -> str:
+        """Canonical manifest key for a path-list entry (flow entries are
+        (rgb, flow-or-None) pairs; the rgb path identifies the video)."""
+        vp = video_path_of(entry)
+        if isinstance(vp, (list, tuple)):
+            vp = vp[0]
+        return str(vp)
+
+    def _resume_skip_reason(self, entry) -> Optional[str]:
+        """Why --resume would skip this video, or None to process it:
+        outputs already on disk, or the manifest recorded a PERMANENT
+        failure in a prior run (retrying bad bytes forever is the failure
+        mode --retry_failed gates)."""
+        if not self.config.resume or self.external_call:
+            return None
+        if self._video_key(entry) in self._prior_failed:
+            return "prior permanent failure (pass --retry_failed to re-attempt)"
+        if self._probe_done_safe(entry):
+            return "outputs exist"
+        return None
+
+    def _skip(self, entry, reason: str) -> None:
+        self.manifest.record(self._video_key(entry), "skipped", message=reason)
+        self.progress.update()
+
+    def _mark_start(self, entry) -> None:
+        self._t0[self._video_key(entry)] = time.monotonic()
+
+    def _wall(self, entry) -> Optional[float]:
+        t0 = self._t0.get(self._video_key(entry))
+        return time.monotonic() - t0 if t0 is not None else None
+
+    def _on_success(self, entry, attempt: int, note: Optional[str] = None) -> None:
+        extra = {"note": note} if note else {}
+        self.manifest.record(
+            self._video_key(entry),
+            "done",
+            attempts=attempt,
+            wall_s=self._wall(entry),
+            **extra,
+        )
+        self.progress.update()
+
+    def _on_failure(
+        self, entry, stage: str, attempt: int, requeue=None, fallback=None
+    ) -> None:
+        """The per-video failure policy, called from an ``except`` block
+        (the live exception is read off sys.exc_info):
+
+        - transient/oom AND attempts left AND the caller can requeue ->
+          record ``retry`` and re-enter the work queue after
+          :func:`faults.backoff_delay`;
+        - compile AND the caller has a degradation path (device->host
+          preprocess) -> record ``fallback`` and run it (the fallback
+          records its own terminal outcome);
+        - otherwise -> record ``failed`` and print the reference failure
+          contract ("An error occurred ... Continuing...").
+
+        An exception's own ``stage`` attribute (set by decode/injected
+        errors) overrides the caller's coarser label — a DecodeTimeout
+        surfacing from a prepare future is a decode failure."""
+        exc = sys.exc_info()[1]
+        stage = getattr(exc, "stage", None) or stage
+        error_class = faults.classify_error(exc) if exc is not None else "permanent"
+        video = self._video_key(entry)
+        retries = int(getattr(self.config, "retries", 0) or 0)
+        if (
+            requeue is not None
+            and faults.is_retryable(error_class)
+            and attempt <= retries
+        ):
+            delay = faults.backoff_delay(
+                attempt, float(getattr(self.config, "retry_backoff", 0.0)), video
+            )
+            self.manifest.record(
+                video,
+                "retry",
+                stage=stage,
+                error_class=error_class,
+                error_type=type(exc).__name__,
+                message=str(exc),
+                attempts=attempt,
+                wall_s=self._wall(entry),
+            )
+            print(
+                f"Transient {stage} failure for {video} (attempt "
+                f"{attempt}/{retries + 1}): {type(exc).__name__}: {exc}; "
+                f"retrying in {delay:.2f}s"
+            )
+            requeue(delay)
+            return
+        if error_class == "compile" and fallback is not None:
+            self.manifest.record(
+                video,
+                "fallback",
+                stage=stage,
+                error_class=error_class,
+                error_type=type(exc).__name__,
+                message=str(exc),
+                attempts=attempt,
+            )
+            fallback()
+            return
+        self.manifest.record(
+            video,
+            "failed",
+            stage=stage,
+            error_class=error_class,
+            error_type=type(exc).__name__ if exc is not None else None,
+            message=str(exc) if exc is not None else None,
+            attempts=attempt,
+            wall_s=self._wall(entry),
+        )
+        self._report_video_error(entry)
+
+    def _fallback_closure(self, device, state, pos, attempt, entry, results):
+        """The degradation path handed to ``_on_failure``: None unless
+        this run uses --preprocess device (the only path with a second,
+        differently-compiled program to fall back to)."""
+        if not self._device_preprocess_enabled():
+            return None
+
+        def do() -> None:
+            self._run_host_fallback(device, state, pos, attempt, entry, results)
+
+        return do
+
+    def _run_host_fallback(self, device, state, pos, attempt, entry, results) -> None:
+        """Re-run ONE video through the host preprocess chain after its
+        fused device-preprocess program failed to compile/lower. The
+        extractors' state bundles always build both entry points (CLIP's
+        encode_image + encode_raw, ResNet's forward + forward_raw), and
+        prepare() branches on ``_device_preprocess_enabled()`` — so
+        flipping the thread-local flag re-prepares a host payload that
+        extract_prepared routes down the host branch."""
+        video = self._video_key(entry)
+        print(
+            f"Device-preprocess compile failure for {video}; "
+            f"falling back to the host chain"
+        )
+        self._force_host.on = True
+        try:
+            with self.timer.stage("prepare"):
+                payload = self.prepare(entry)
+            with self.timer.stage("device"):
+                feats_dict = self.extract_prepared(device, state, entry, payload)
+            self._sink_or_collect(feats_dict, entry, results, pos)
+        except KeyboardInterrupt:
+            raise
+        except Exception:  # noqa: BLE001 - fallback is terminal: no retry loop
+            self._on_failure(entry, "dispatch", attempt)
+            return
+        finally:
+            self._force_host.on = False
+        self._on_success(entry, attempt, note="device->host preprocess fallback")
 
     def __call__(
         self,
@@ -257,26 +469,46 @@ class BaseExtractor:
             if pipelined:
                 self._run_pipelined(indices, device, state, results)
             else:
-                for pos, idx in enumerate(indices):
-                    entry = self.path_list[idx]
-
-                    def one(entry=entry, pos=pos):
-                        if (
-                            self.config.resume
-                            and not self.external_call
-                            and self._already_done(entry)
-                        ):
-                            return
-                        with self.timer.stage("extract"):
-                            feats_dict = self.extract(device, state, entry)
-                        self._sink_or_collect(feats_dict, entry, results, pos)
-
-                    self._isolate(entry, one)
+                self._run_serial(indices, device, state, results)
         if self.config.profile_dir:
             print(self.timer.summary())
         if self.external_call:
             return [d for _, d in sorted(results, key=lambda t: t[0])]
         return None
+
+    def _run_serial(self, indices, device, state, results) -> None:
+        """The reference-shaped serial loop, now over a retry deque:
+        transient failures re-enter the queue with their backoff deadline
+        (``not_before``) instead of being dropped after one try."""
+        from collections import deque
+
+        queue: deque = deque((pos, idx, 1, 0.0) for pos, idx in enumerate(indices))
+        while queue:
+            pos, idx, attempt, not_before = queue.popleft()
+            entry = self.path_list[idx]
+            if attempt == 1:
+                reason = self._resume_skip_reason(entry)
+                if reason is not None:
+                    self._skip(entry, reason)
+                    continue
+            wait = not_before - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            self._mark_start(entry)
+            try:
+                with self.timer.stage("extract"):
+                    feats_dict = self.extract(device, state, entry)
+                self._sink_or_collect(feats_dict, entry, results, pos)
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - classify, maybe retry
+
+                def _requeue(delay, pos=pos, idx=idx, attempt=attempt):
+                    queue.append((pos, idx, attempt + 1, time.monotonic() + delay))
+
+                self._on_failure(entry, "extract", attempt, requeue=_requeue)
+                continue
+            self._on_success(entry, attempt)
 
     def _run_pipelined(self, indices, device, state, results) -> None:
         """Decode/preprocess on ``--decode_workers`` host threads, device
@@ -296,41 +528,92 @@ class BaseExtractor:
         forward instead of N tiny ones. Up to N-1 prepared payloads per
         shape key stay host-resident while a group fills; extractors
         whose payloads can be large return ``agg_key=None`` above a size
-        cap, which routes that video through the individual path."""
+        cap, which routes that video through the individual path.
+
+        Failure policy (runtime/faults.py; docs/robustness.md): every
+        per-video failure goes through ``_on_failure`` — transient ones
+        re-enter ``pending`` as a fresh prepare future after backoff
+        (``requeue``), compile failures under --preprocess device degrade
+        to the host chain, fused-group failures fall back to per-video
+        dispatch, and everything terminal lands in the run manifest."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         workers = max(1, int(self.config.decode_workers))
         depth = workers + 1  # prepared-and-waiting beyond the one consumed
 
-        def prep(entry):
+        def prep(entry, delay: float = 0.0):
+            if delay > 0:
+                time.sleep(delay)  # backoff burns a decode worker, not the device loop
+            self._mark_start(entry)
             with self.timer.stage("prepare"):
+                faults.fire("prepare")
                 return self.prepare(entry)
 
-        pending: deque = deque()
+        pending: deque = deque()  # (pos, idx, attempt, fut)
         # device pipeline (extractors with the dispatch/fetch split): one
         # video's transfer+compute stays in flight while the previous
         # video's results are fetched/sunk
         split = self._supports_device_pipeline()
         agg = self._aggregation_enabled()
         group_size = max(int(self.config.video_batch or 1), 1)
-        groups: Dict[Any, list] = {}  # agg_key -> [(pos, entry, payload)]
-        # ([(pos, entry), ...], handle, grouped, payloads-or-None); grouped
-        # entries keep their payloads host-resident until fetch succeeds so
-        # a fused failure can fall back to the solo path (inflight depth is
-        # <=2, so at most two groups' payloads stay pinned)
+        groups: Dict[Any, list] = {}  # agg_key -> [(pos, idx, attempt, entry, payload)]
+        # ([(pos, idx, attempt, entry), ...], handle, grouped,
+        # payloads-or-None); grouped entries keep their payloads
+        # host-resident until fetch succeeds so a fused failure can fall
+        # back to the solo path (inflight depth is <=2, so at most two
+        # groups' payloads stay pinned)
         inflight: deque = deque()
 
-        def run_solo(pos, entry, payload):
-            """The individual device path for one prepared video (shared
-            by the non-split dispatch branch and the group fallback)."""
+        def requeue(pos, idx, attempt):
+            """Retry closure for _on_failure: resubmit a prepare future
+            (delayed by backoff) at attempt+1. Retries during the final
+            drain re-enter ``pending``, which the outer drain loop below
+            keeps consuming."""
 
-            def one():
+            def do(delay: float) -> None:
+                pending.append(
+                    (pos, idx, attempt + 1, pool.submit(prep, self.path_list[idx], delay))
+                )
+
+            return do
+
+        def sink_one(pos, idx, attempt, entry, feats_dict):
+            try:
+                self._sink_or_collect(feats_dict, entry, results, pos)
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - sink failed: this video only
+                self._on_failure(
+                    entry, "sink", attempt, requeue=requeue(pos, idx, attempt)
+                )
+                return
+            self._on_success(entry, attempt)
+
+        def run_solo(pos, idx, attempt, entry, payload, inject: bool = True):
+            """The individual device path for one prepared video (shared
+            by the non-split dispatch branch and the group fallback —
+            which passes inject=False so the dispatch injection counter
+            cannot re-fail the members it is recovering)."""
+            try:
+                if inject:
+                    faults.fire("dispatch")
                 with self.timer.stage("device"):
                     feats_dict = self.extract_prepared(device, state, entry, payload)
-                self._sink_or_collect(feats_dict, entry, results, pos)
-
-            self._isolate(entry, one)
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - classify, maybe retry/degrade
+                self._on_failure(
+                    entry,
+                    "dispatch",
+                    attempt,
+                    requeue=requeue(pos, idx, attempt),
+                    fallback=self._fallback_closure(
+                        device, state, pos, attempt, entry, results
+                    ),
+                )
+                return
+            sink_one(pos, idx, attempt, entry, feats_dict)
 
         def solo_fallback(items, phase, fused_err):
             """A fused dispatch/fetch died (OOM, one bad interaction):
@@ -348,8 +631,15 @@ class BaseExtractor:
                 f"{len(items)}; falling back to per-video dispatch:"
             )
             print(fused_err, end="")
-            for pos, e, p in items:
-                run_solo(pos, e, p)
+            self.manifest.event(
+                "group_fallback",
+                phase=phase,
+                size=len(items),
+                videos=[self._video_key(e) for _, _, _, e, _ in items],
+                message=fused_err.strip().splitlines()[-1][:300] if fused_err else None,
+            )
+            for pos, idx, attempt, e, p in items:
+                run_solo(pos, idx, attempt, e, p, inject=False)
 
         def fetch_one():
             slots, handle, grouped, payloads = inflight.popleft()
@@ -369,28 +659,45 @@ class BaseExtractor:
                     # exited, so no live traceback pins them either
                     del handle
                     solo_fallback(
-                        [(pos, e, p) for (pos, e), p in zip(slots, payloads)],
+                        [
+                            (pos, idx, att, e, p)
+                            for (pos, idx, att, e), p in zip(slots, payloads)
+                        ],
                         "fetch",
                         fused_err,
                     )
                     return
-                for (pos, e), d in zip(slots, dicts):
-                    self._isolate(e, self._sink_or_collect, d, e, results, pos)
+                for (pos, idx, att, e), d in zip(slots, dicts):
+                    sink_one(pos, idx, att, e, d)
                 return
-            pos, entry = slots[0]
-
-            def one():
+            pos, idx, attempt, entry = slots[0]
+            try:
                 with self.timer.stage("device"):
                     feats_dict = self.fetch_dispatched(handle)
-                self._sink_or_collect(feats_dict, entry, results, pos)
+            except KeyboardInterrupt:
+                raise
+            except Exception:  # noqa: BLE001 - classify, maybe retry/degrade
+                self._on_failure(
+                    entry,
+                    "dispatch",
+                    attempt,
+                    requeue=requeue(pos, idx, attempt),
+                    fallback=self._fallback_closure(
+                        device, state, pos, attempt, entry, results
+                    ),
+                )
+                return
+            sink_one(pos, idx, attempt, entry, feats_dict)
 
-            self._isolate(entry, one)
-
-        def dispatch_group_now(items):  # items: [(pos, entry, payload)]
-            entries = [e for _, e, _ in items]
-            payloads = [p for _, _, p in items]
+        def dispatch_group_now(items):  # items: [(pos, idx, attempt, entry, payload)]
+            entries = [e for _, _, _, e, _ in items]
+            payloads = [p for *_, p in items]
             fused_err = None
             try:
+                # one dispatch-injection call per GROUP (the dispatch is
+                # one device program); the OOM spec's split-then-recover
+                # path is exactly this: fused raise -> solo_fallback
+                faults.fire("dispatch")
                 with self.timer.stage("device"):
                     handle = self.dispatch_group(device, state, entries, payloads)
             except KeyboardInterrupt:
@@ -401,18 +708,19 @@ class BaseExtractor:
                 solo_fallback(items, "dispatch", fused_err)
                 return
             inflight.append(
-                ([(pos, e) for pos, e, _ in items], handle, True, payloads)
+                ([(pos, idx, att, e) for pos, idx, att, e, _ in items], handle, True, payloads)
             )
             if len(inflight) > 1:
                 fetch_one()
 
-        def dispatch_single(pos, entry, payload):
+        def dispatch_single(pos, idx, attempt, entry, payload):
             if split:
                 try:
+                    faults.fire("dispatch")
                     with self.timer.stage("device"):
                         inflight.append(
                             (
-                                [(pos, entry)],
+                                [(pos, idx, attempt, entry)],
                                 self.dispatch_prepared(device, state, entry, payload),
                                 False,
                                 None,
@@ -420,57 +728,71 @@ class BaseExtractor:
                         )
                 except KeyboardInterrupt:
                     raise
-                except Exception:  # noqa: BLE001 - same per-video isolation
-                    self._report_video_error(entry)
+                except Exception:  # noqa: BLE001 - classify, maybe retry/degrade
+                    self._on_failure(
+                        entry,
+                        "dispatch",
+                        attempt,
+                        requeue=requeue(pos, idx, attempt),
+                        fallback=self._fallback_closure(
+                            device, state, pos, attempt, entry, results
+                        ),
+                    )
                 if len(inflight) > 1:
                     fetch_one()
                 return
 
-            run_solo(pos, entry, payload)
+            run_solo(pos, idx, attempt, entry, payload)
 
         def consume_one():
-            pos, idx, fut = pending.popleft()
+            pos, idx, attempt, fut = pending.popleft()
             entry = self.path_list[idx]
             try:
                 payload = fut.result()
                 key = self.agg_key(payload) if agg else None
             except KeyboardInterrupt:
                 raise
-            except Exception:  # noqa: BLE001 - prepare failed: this video only
-                self._report_video_error(entry)
+            except Exception:  # noqa: BLE001 - prepare/decode failed: classify
+                # decode errors carry stage='decode' on the exception;
+                # everything else surfacing from the future is 'prepare'
+                self._on_failure(
+                    entry, "prepare", attempt, requeue=requeue(pos, idx, attempt)
+                )
                 return
             if key is not None:
                 buf = groups.setdefault(key, [])
-                buf.append((pos, entry, payload))
+                buf.append((pos, idx, attempt, entry, payload))
                 if len(buf) >= group_size:
                     del groups[key]
                     dispatch_group_now(buf)
                 return
-            dispatch_single(pos, entry, payload)
+            dispatch_single(pos, idx, attempt, entry, payload)
 
         with ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"decode-{device}"
         ) as pool:
             for pos, idx in enumerate(indices):
                 entry = self.path_list[idx]
-                if (
-                    self.config.resume
-                    and not self.external_call
-                    and self._probe_done_safe(entry)
-                ):
-                    self.progress.update()
+                reason = self._resume_skip_reason(entry)
+                if reason is not None:
+                    self._skip(entry, reason)
                     continue
-                pending.append((pos, idx, pool.submit(prep, entry)))
+                pending.append((pos, idx, 1, pool.submit(prep, entry)))
                 if len(pending) > depth:
                     consume_one()
-            while pending:
-                consume_one()
-            for buf in groups.values():  # flush partial groups (< N videos)
-                if buf:
-                    dispatch_group_now(buf)
-            groups.clear()
-            while inflight:
-                fetch_one()
+            # retries re-enter `pending` from any of the drains below
+            # (consume/dispatch/fetch/sink), so the drain is ONE outer
+            # loop: separate sequential drains would strand a video
+            # requeued after its phase's drain had already passed
+            while pending or groups or inflight:
+                while pending:
+                    consume_one()
+                for key in list(groups):  # flush partial groups (< N videos)
+                    buf = groups.pop(key)
+                    if buf:
+                        dispatch_group_now(buf)
+                while inflight and not pending:
+                    fetch_one()
 
     def _probe_done_safe(self, entry) -> bool:
         try:

@@ -14,7 +14,9 @@ import os
 import pathlib
 import shutil
 import subprocess
-from typing import Tuple
+from typing import Optional, Tuple
+
+from video_features_tpu.runtime.faults import DecodeTimeout
 
 
 def which_ffmpeg() -> str:
@@ -32,7 +34,12 @@ def require_ffmpeg() -> str:
     return path
 
 
-def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: float) -> str:
+def reencode_video_with_diff_fps(
+    video_path: str,
+    tmp_path: str,
+    extraction_fps: float,
+    timeout_s: Optional[float] = None,
+) -> str:
     """Re-encode to target fps into tmp_path (ref utils/utils.py:222-244).
 
     The output name carries a hash of the absolute source path: the
@@ -51,13 +58,20 @@ def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps:
     new_path = os.path.join(tmp_path, f"{stem}_{tag}_new_fps_{extraction_fps:g}.mp4")
     part = new_path + f".part{os.getpid()}.mp4"
     _run([ffmpeg, "-hide_banner", "-loglevel", "error", "-y", "-i", video_path,
-          "-filter:v", f"fps=fps={extraction_fps}", part])
+          "-filter:v", f"fps=fps={extraction_fps}", part], timeout_s=timeout_s)
     os.replace(part, new_path)
     return new_path
 
 
-def _run(cmd) -> None:
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+def _run(cmd, timeout_s: Optional[float] = None) -> None:
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        # subprocess.run already killed the child; surface the same
+        # transient deadline error class as an in-process decode stall
+        raise DecodeTimeout(
+            f"ffmpeg exceeded --decode_timeout {timeout_s:g}s: {' '.join(cmd)}"
+        ) from e
     if proc.returncode != 0:
         raise RuntimeError(
             f"ffmpeg failed (exit {proc.returncode}): {' '.join(cmd)}\n{proc.stderr.strip()}"

@@ -13,9 +13,13 @@ from __future__ import annotations
 import os
 import pathlib
 import pickle
+import threading
+import uuid
 from typing import Dict, List, Union
 
 import numpy as np
+
+from video_features_tpu.runtime import faults
 
 META_KEYS = ("fps", "timestamps_ms")
 _SUFFIX = {"save_numpy": "npy", "save_pickle": "pkl"}
@@ -66,11 +70,14 @@ def action_on_extraction(
     output_path: str,
     on_extraction: str,
     output_direct: bool = False,
-) -> None:
-    suffix = {"save_numpy": "npy", "save_pickle": "pkl"}
+) -> List[str]:
+    """Returns warnings (currently: empty-feature values) so the caller
+    can record them in the run manifest; ``--strict`` fails the run on
+    them (docs/robustness.md)."""
     if isinstance(video_path, (list, tuple)):
         video_path = video_path[0]
     name = pathlib.Path(video_path).stem
+    warnings: List[str] = []
 
     for key, value in feats_dict.items():
         if key in META_KEYS:
@@ -87,16 +94,35 @@ def action_on_extraction(
             )
             os.makedirs(os.path.dirname(fpath), exist_ok=True)
             if len(value) == 0:
-                print(f"Warning: the value is empty for {key} @ {fpath}")
+                msg = f"the value is empty for {key} @ {fpath}"
+                print(f"Warning: {msg}")
+                warnings.append(msg)
             # write tmp + rename: a run killed mid-save must not leave a
-            # truncated file that --resume would then trust as complete
-            tmp = f"{fpath}.{os.getpid()}.tmp"
-            with open(tmp, "wb") as f:
-                if on_extraction == "save_numpy":
-                    np.save(f, value)
-                else:
-                    pickle.dump(value, f)
-            os.replace(tmp, fpath)
+            # truncated file that --resume would then trust as complete.
+            # The tmp name carries thread id + uuid, not just pid: two
+            # worker THREADS re-running a requeued video share a pid and
+            # would clobber (then os.replace) each other's half-written
+            # tmp file.
+            tmp = (
+                f"{fpath}.{os.getpid()}-{threading.get_ident()}"
+                f"-{uuid.uuid4().hex[:8]}.tmp"
+            )
+            try:
+                with open(tmp, "wb") as f:
+                    if on_extraction == "save_numpy":
+                        np.save(f, value)
+                    else:
+                        pickle.dump(value, f)
+                # injected sink faults land between write and rename: the
+                # worst moment — bytes on disk, nothing committed
+                faults.fire("sink")
+                os.replace(tmp, fpath)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
         elif on_extraction == "save_jpg":
             # flow (T, 2, H, W) float -> per-pair flow_x_/flow_y_ grayscale
             # jpgs holding the uint8-quantized flow (clamp ±20, 128+255/40·f
@@ -128,3 +154,4 @@ def action_on_extraction(
                     )
         else:
             raise NotImplementedError(f"on_extraction: {on_extraction} is not implemented")
+    return warnings

@@ -37,12 +37,17 @@ utils/utils.py:312) which is a unit bug; the correct milliseconds-per-frame
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Iterator, List, Optional, Tuple
 
 import cv2
 import numpy as np
 
+from video_features_tpu.runtime import faults
+from video_features_tpu.runtime.faults import CorruptVideoError, DecodeTimeout
+
 _DECODER = "auto"  # 'auto' | 'cv2' | 'native'; set once from the config
+_DECODE_TIMEOUT: Optional[float] = None  # seconds per reader; set from the config
 
 
 def set_decoder(name: str) -> None:
@@ -53,6 +58,16 @@ def set_decoder(name: str) -> None:
     if name not in ("auto", "cv2", "native"):
         raise ValueError(f"unknown decoder backend: {name!r}")
     _DECODER = name
+
+
+def set_decode_timeout(seconds: Optional[float]) -> None:
+    """Wall-clock budget per reader lifetime (``--decode_timeout``); a
+    reader open longer than this raises :class:`DecodeTimeout` from its
+    next ``grab()``. None disables. Module-global like the decoder
+    choice: the readers are constructed deep inside samplers that don't
+    thread config through."""
+    global _DECODE_TIMEOUT
+    _DECODE_TIMEOUT = float(seconds) if seconds else None
 
 
 def _resolve(decoder: Optional[str]) -> str:
@@ -86,9 +101,11 @@ class _Reader:
             if native.decoder_available():
                 try:
                     self._nat = native.NativeVideoReader(path)
-                except IOError:
+                except IOError as e:
                     if d == "native":
-                        raise
+                        # forced native: an unopenable container is bad
+                        # bytes, not a flake — fail fast, don't retry
+                        raise CorruptVideoError(str(e)) from e
             elif d == "native":
                 raise RuntimeError(
                     f"--decoder native requested but the decode library is "
@@ -101,13 +118,24 @@ class _Reader:
         else:
             self._cap = cv2.VideoCapture(str(path))
             if not self._cap.isOpened():
-                raise IOError(f"cannot open video: {path}")
+                raise CorruptVideoError(f"cannot open video: {path}")
             self.fps = self._cap.get(cv2.CAP_PROP_FPS) or 0.0
             self.frame_count = int(self._cap.get(cv2.CAP_PROP_FRAME_COUNT))
             self.width = int(self._cap.get(cv2.CAP_PROP_FRAME_WIDTH))
             self.height = int(self._cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+        self._path = str(path)
+        self._deadline = (
+            time.monotonic() + _DECODE_TIMEOUT if _DECODE_TIMEOUT else None
+        )
+        # injected 'decode' faults land here, after open: a hang eats
+        # into this reader's deadline exactly like a stalled demuxer
+        faults.fire("decode")
 
     def grab(self) -> bool:
+        if self._deadline is not None and time.monotonic() > self._deadline:
+            raise DecodeTimeout(
+                f"decode exceeded --decode_timeout {_DECODE_TIMEOUT:g}s: {self._path}"
+            )
         if self._nat is not None:
             return self._nat.grab() >= 0
         return self._cap.grab()
@@ -293,7 +321,9 @@ def extract_frames(
     meta = probe(path, decoder)
     fps, frame_cnt = meta.fps or 25.0, meta.frame_count
     if frame_cnt < 3:
-        raise IOError(f"video too short for sampling ({frame_cnt} frames): {path}")
+        raise CorruptVideoError(
+            f"video too short for sampling ({frame_cnt} frames): {path}"
+        )
     mspf = 1000.0 / fps
 
     if ext == "fix":
@@ -312,7 +342,7 @@ def extract_frames(
     # sampled-feature contract on it.
     got = read_frames_at_indices(path, samples_ix, decoder, allow_seek=False)
     if not got:
-        raise IOError(f"no frames decoded from {path}")
+        raise CorruptVideoError(f"no frames decoded from {path}")
     # duplicate indices in linspace (short videos) resolve to the same frame
     last_seen = None
     frames = []

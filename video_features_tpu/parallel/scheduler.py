@@ -20,7 +20,9 @@ from __future__ import annotations
 import queue
 import threading
 import traceback
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from video_features_tpu.runtime.faults import NULL_MANIFEST
 
 
 def mesh_feature_extraction(extractor, devices: Optional[Sequence] = None) -> None:
@@ -109,9 +111,53 @@ def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -
     for idx in own:
         work.put(idx)
 
-    errors: List[BaseException] = []
+    # Every worker death lands in the run manifest (the extractor may be
+    # a test fake without one — the NULL manifest swallows records).
+    manifest = getattr(extractor, "manifest", None) or NULL_MANIFEST
+    errors: List[Tuple[object, BaseException]] = []  # (device, exc)
+    # How many times each index was re-queued by a worker death: capped
+    # at the config retry budget, after which the video is recorded
+    # failed instead of ping-ponging between dying workers forever.
+    requeue_counts: Dict[int, int] = {}
+    requeue_lock = threading.Lock()
+    retries = int(getattr(extractor.config, "retries", 2) or 0)
     dead: set = set()
     interrupted = threading.Event()
+
+    def record_death(device, exc: BaseException, phase: str) -> None:
+        errors.append((device, exc))
+        dead.add(device)
+        traceback.print_exc()
+        manifest.event(
+            "worker_death",
+            device=str(device),
+            phase=phase,
+            error_type=type(exc).__name__,
+            message=str(exc)[:300],
+        )
+
+    def requeue_or_drop(chunk: List[int]) -> None:
+        for idx in chunk:
+            with requeue_lock:
+                requeue_counts[idx] = count = requeue_counts.get(idx, 0) + 1
+            if count > retries:
+                entry = extractor.path_list[idx]
+                video = getattr(extractor, "_video_key", lambda e: str(e))(entry)
+                print(
+                    f"Dropping {video}: re-queued {count - 1} time(s) by "
+                    "worker deaths, retry budget exhausted"
+                )
+                manifest.record(
+                    video,
+                    "failed",
+                    stage="worker",
+                    error_class="transient",
+                    message=f"worker died {count} times holding this video",
+                    attempts=count,
+                )
+                extractor.progress.update()
+            else:
+                work.put(idx)
 
     # Workers pull CHUNKS so the extractor's async host pipeline
     # (--decode_workers prefetch, extract/base.py::_run_pipelined) has a
@@ -133,9 +179,7 @@ def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -
         try:
             extractor.warmup(device)
         except Exception as e:  # noqa: BLE001 - surface below
-            errors.append(e)
-            dead.add(device)
-            traceback.print_exc()
+            record_death(device, e, "warmup")
             return
         while not interrupted.is_set():
             chunk: List[int] = []
@@ -154,16 +198,15 @@ def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -
             except BaseException as e:  # noqa: BLE001 - worker death
                 # An escape past the extractor's per-video isolation kills
                 # this worker. Put the in-flight chunk back for the next
-                # drain pass (otherwise it would be silently lost) and
-                # record the death so the run can't exit clean with
-                # missing outputs. Items of the chunk that already
-                # completed may re-run — harmless, the sink's atomic
-                # writes are idempotent.
-                errors.append(e)
-                dead.add(device)
-                traceback.print_exc()
-                for idx in chunk:
-                    work.put(idx)
+                # drain pass (otherwise it would be silently lost — capped
+                # per index so repeatedly-fatal videos are recorded failed
+                # rather than ping-ponged between dying workers) and record
+                # the death so the run can't exit clean with missing
+                # outputs. Items of the chunk that already completed may
+                # re-run — harmless, the sink's atomic writes are
+                # idempotent.
+                record_death(device, e, "extract")
+                requeue_or_drop(chunk)
                 return
 
     live = list(devices)
@@ -188,15 +231,24 @@ def parallel_feature_extraction(extractor, devices: Optional[Sequence] = None) -
         raise KeyboardInterrupt
     if not work.empty():
         # every device's worker died with items still queued — outputs ARE
-        # missing; a clean exit here would hide that (VERDICT r1 weak #4)
+        # missing; a clean exit here would hide that (VERDICT r1 weak #4).
+        # Summarize EVERY death (the old message chained only errors[0],
+        # discarding the rest — ISSUE 3 satellite).
+        deaths = "; ".join(
+            f"{d}: {type(e).__name__}: {str(e)[:200]}" for d, e in errors
+        )
         raise RuntimeError(
             f"all extraction workers died with {work.qsize()} of {len(own)} videos "
-            "unprocessed"
-        ) from (errors[0] if errors else None)
+            f"unprocessed ({len(errors)} worker death(s): {deaths})"
+        ) from (errors[0][1] if errors else None)
     if errors:
         # queue drained (survivors re-ran the re-queued items) but some
         # worker(s) died along the way — say so instead of exiting silently
+        deaths = "; ".join(
+            f"{d}: {type(e).__name__}: {str(e)[:200]}" for d, e in errors
+        )
         print(
             f"WARNING: {len(errors)} extraction worker(s) died mid-run; "
-            "their videos were re-queued and completed by surviving workers."
+            "their videos were re-queued and completed by surviving workers "
+            f"(or recorded failed past the retry cap). Deaths: {deaths}"
         )

@@ -1,0 +1,5 @@
+"""Run-level runtime services that sit above the extractors: fault
+classification, retry policy, the run manifest, and the deterministic
+fault-injection hook (faults.py). Nothing here may import jax — the
+manifest must stay writable from decode worker threads and from the
+scheduler's death paths even when the accelerator runtime is wedged."""

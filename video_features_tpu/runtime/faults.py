@@ -1,0 +1,499 @@
+"""Fault tolerance: error classification, retry policy, the run
+manifest, and deterministic fault injection.
+
+The reference's only failure contract is "print the traceback and
+continue" (ref extract_clip.py:78-84): after a million-video run there
+is no machine-readable record of WHICH videos failed, WHY, or whether
+retrying would help. This module is the missing contract layer
+(docs/robustness.md):
+
+- :func:`classify_error` buckets an exception into ``transient`` (I/O
+  flake, decode deadline, RESOURCE_EXHAUSTED — retrying may help),
+  ``oom`` (device memory pressure — retrying alone or after splitting a
+  fused group may help), ``compile`` (XLA lowering/compilation failure —
+  retrying the same program is useless, but a different program, e.g.
+  the host preprocess chain, may work), or ``permanent`` (corrupt
+  container, shape mismatch — fail fast, record, move on).
+- :class:`RunManifest` appends one JSONL record per per-video outcome
+  (status, stage, error class, attempts, wall time) to a per-process
+  file under ``<output_path>/_manifest/``; :func:`merge_manifest` folds
+  every process's records (including prior runs' — that is what makes
+  ``--resume`` consult them) into one summary, and :func:`finalize_run`
+  writes it as ``summary.json``.
+- :func:`backoff_delay` is the exponential-backoff-with-deterministic-
+  jitter schedule the retry paths share (the jitter hashes the video
+  path so two workers retrying different videos never thundering-herd,
+  while a re-run of the same job stays reproducible).
+- :class:`FaultInjector` (``--fault_inject STAGE:KIND:EVERY_N``,
+  test-only) deterministically raises or hangs at the decode, prepare,
+  dispatch, or sink stage, so every retry/fallback/manifest path is
+  exercised by fast CPU tests instead of trusted on faith.
+
+No jax imports here: the manifest must stay writable from decode worker
+threads and the scheduler's worker-death path even when the accelerator
+runtime is wedged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+MANIFEST_DIRNAME = "_manifest"
+SUMMARY_BASENAME = "summary.json"
+
+STAGES = ("decode", "prepare", "dispatch", "sink")
+KINDS = ("error", "corrupt", "hang", "oom", "compile", "kill")
+# how long an injected 'hang' sleeps; tests pair it with a shorter
+# --decode_timeout so the REAL deadline check fires, not a mock
+HANG_SECONDS = 0.4
+
+RETRYABLE_CLASSES = ("transient", "oom")
+
+
+# --- exception taxonomy -----------------------------------------------------
+
+class DecodeTimeout(Exception):
+    """Decode exceeded ``--decode_timeout`` (a stalled demuxer/NFS read,
+    or an injected hang). Transient: the next attempt gets a fresh
+    deadline."""
+
+    stage = "decode"
+
+
+class CorruptVideoError(IOError):
+    """The container itself is bad (cannot open, zero frames decodable,
+    too short to sample). Permanent: no number of retries fixes bytes."""
+
+    stage = "decode"
+
+
+class InjectedTransientError(OSError):
+    """--fault_inject KIND=error: an I/O flake."""
+
+
+class InjectedPermanentError(ValueError):
+    """--fault_inject KIND=corrupt: unfixable bad input."""
+
+
+class InjectedOOMError(RuntimeError):
+    """--fault_inject KIND=oom: message carries RESOURCE_EXHAUSTED so the
+    real classifier (not a test-only branch) routes it."""
+
+
+class InjectedCompileError(RuntimeError):
+    """--fault_inject KIND=compile: message carries 'lowering' so the
+    real classifier routes it to the degradation path."""
+
+
+class InjectedSinkKill(RuntimeError):
+    """--fault_inject KIND=kill: simulates the process dying mid-save —
+    raised after the tmp file is written but before the atomic rename."""
+
+    stage = "sink"
+
+
+# --- classification ---------------------------------------------------------
+
+# message markers for errors whose TYPE is opaque (jaxlib wraps most
+# device failures in one XlaRuntimeError); heuristic by necessity,
+# documented in docs/robustness.md
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory", "OOM")
+_COMPILE_MARKERS = (
+    "lowering",
+    "compilation",
+    "Compilation",
+    "UNIMPLEMENTED",
+    "Mosaic",
+    "INVALID_ARGUMENT",
+)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Bucket ``exc`` into 'transient' | 'oom' | 'compile' | 'permanent'.
+
+    Order matters: the specific contracts (corrupt container, decode
+    deadline) win over the broad isinstance checks (CorruptVideoError IS
+    an OSError, but bad bytes never become good bytes)."""
+    if isinstance(exc, CorruptVideoError):
+        return "permanent"
+    if isinstance(exc, DecodeTimeout):
+        return "transient"
+    if isinstance(exc, MemoryError):
+        return "oom"
+    msg = str(exc)
+    if any(m in msg for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in _COMPILE_MARKERS):
+        return "compile"
+    if isinstance(exc, (OSError, TimeoutError)):
+        # covers IOError decode/sink flakes and subprocess deadline kills
+        return "transient"
+    return "permanent"
+
+
+def is_retryable(error_class: str) -> bool:
+    """Whether re-entering the work queue can help (docs/robustness.md:
+    'compile' is NOT retryable — the same program lowers the same way —
+    it degrades to the host chain instead)."""
+    return error_class in RETRYABLE_CLASSES
+
+
+def backoff_delay(attempt: int, base: float, key: str) -> float:
+    """Exponential backoff with deterministic jitter for retry ``attempt``
+    (1-based). Jitter derives from sha1(key, attempt): different videos
+    desynchronize (no thundering herd after a shared-filesystem blip),
+    identical re-runs reproduce exactly."""
+    if base <= 0:
+        return 0.0
+    digest = hashlib.sha1(f"{key}:{attempt}".encode()).digest()
+    frac = digest[0] / 255.0  # [0, 1]
+    return base * (2.0 ** (attempt - 1)) * (0.5 + 0.5 * frac)
+
+
+# --- fault injection --------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    stage: str
+    kind: str
+    every_n: int
+
+
+def parse_fault_specs(specs: Optional[Sequence[str]]) -> List[FaultSpec]:
+    """Parse ``--fault_inject STAGE:KIND:EVERY_N`` values; raises
+    ValueError naming the bad spec (sanity_check calls this so a typo
+    dies at arg-parse time, not mid-run)."""
+    out: List[FaultSpec] = []
+    for raw in specs or ():
+        parts = str(raw).split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"--fault_inject expects STAGE:KIND:EVERY_N, got {raw!r}"
+            )
+        stage, kind, every = parts
+        if stage not in STAGES:
+            raise ValueError(
+                f"--fault_inject stage {stage!r} not in {STAGES} ({raw!r})"
+            )
+        if kind not in KINDS:
+            raise ValueError(
+                f"--fault_inject kind {kind!r} not in {KINDS} ({raw!r})"
+            )
+        try:
+            n = int(every)
+        except ValueError:
+            n = 0
+        if n < 1:
+            raise ValueError(
+                f"--fault_inject EVERY_N must be a positive int ({raw!r})"
+            )
+        out.append(FaultSpec(stage, kind, n))
+    return out
+
+
+class FaultInjector:
+    """Deterministic stage-counter injection: ``fire(stage)`` increments
+    that stage's call counter and raises/hangs when any spec's
+    ``counter % every_n == 0``. Counters are process-global per injector,
+    so what constitutes one 'call' is the stage's own unit (decode: one
+    reader open; prepare/dispatch/sink: one video or group)."""
+
+    def __init__(self, specs: Sequence[FaultSpec]) -> None:
+        self._specs: Dict[str, List[FaultSpec]] = {}
+        for s in specs:
+            self._specs.setdefault(s.stage, []).append(s)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def fire(self, stage: str) -> None:
+        specs = self._specs.get(stage)
+        if not specs:
+            return
+        with self._lock:
+            count = self._counts.get(stage, 0) + 1
+            self._counts[stage] = count
+        for spec in specs:
+            if count % spec.every_n == 0:
+                self._raise(spec, count)
+
+    @staticmethod
+    def _raise(spec: FaultSpec, count: int) -> None:
+        tag = f"injected fault {spec.stage}:{spec.kind} (call {count})"
+        if spec.kind == "hang":
+            time.sleep(HANG_SECONDS)  # the real deadline check must fire
+            return
+        exc: Exception
+        if spec.kind == "error":
+            exc = InjectedTransientError(f"{tag}: transient I/O error")
+        elif spec.kind == "corrupt":
+            exc = InjectedPermanentError(f"{tag}: unfixable corrupt input")
+        elif spec.kind == "oom":
+            exc = InjectedOOMError(f"{tag}: RESOURCE_EXHAUSTED: device OOM")
+        elif spec.kind == "compile":
+            exc = InjectedCompileError(f"{tag}: XLA lowering failed")
+        else:  # kill
+            exc = InjectedSinkKill(f"{tag}: process killed mid-save")
+        exc.stage = spec.stage  # lets handlers attribute the true stage
+        raise exc
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def install_injector(specs: Optional[Sequence[str]]) -> None:
+    """Install (or, with None/empty, clear) the process-global injector.
+    Test-only by design: the most recently constructed extractor's config
+    wins, which is exactly the one-run-per-process CLI lifecycle."""
+    global _INJECTOR
+    parsed = parse_fault_specs(specs)
+    _INJECTOR = FaultInjector(parsed) if parsed else None
+
+
+def fire(stage: str) -> None:
+    """Injection point hook; a no-op attribute check on the happy path
+    (bench.py fault_overhead pins its cost at well under 1%)."""
+    inj = _INJECTOR
+    if inj is not None:
+        inj.fire(stage)
+
+
+# --- run manifest -----------------------------------------------------------
+
+def manifest_dir(output_root: str) -> str:
+    return os.path.join(output_root, MANIFEST_DIRNAME)
+
+
+class RunManifest:
+    """Append-only per-process JSONL event log under
+    ``<output_root>/_manifest/events-<pid>-<runid>.jsonl``.
+
+    One file per process (multi-process queue runs and multi-host pods
+    never contend on a writer); one :class:`threading.Lock` per process
+    (decode workers, device workers, and the scheduler's death path all
+    record). Records are flushed per line so a killed run keeps every
+    outcome that preceded the kill."""
+
+    def __init__(self, output_root: str) -> None:
+        self.output_root = output_root
+        self.run_id = uuid.uuid4().hex[:8]
+        self.path = os.path.join(
+            manifest_dir(output_root), f"events-{os.getpid()}-{self.run_id}.jsonl"
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._fh = None
+
+    def record(
+        self,
+        video: Optional[str],
+        status: str,
+        stage: Optional[str] = None,
+        error_class: Optional[str] = None,
+        error_type: Optional[str] = None,
+        message: Optional[str] = None,
+        attempts: Optional[int] = None,
+        wall_s: Optional[float] = None,
+        **extra: Any,
+    ) -> None:
+        row: Dict[str, Any] = {"video": video, "status": status}
+        if stage is not None:
+            row["stage"] = stage
+        if error_class is not None:
+            row["error_class"] = error_class
+        if error_type is not None:
+            row["error_type"] = error_type
+        if message is not None:
+            row["message"] = str(message)[:500]
+        if attempts is not None:
+            row["attempts"] = int(attempts)
+        if wall_s is not None:
+            row["wall_s"] = round(float(wall_s), 4)
+        row.update(extra)
+        self._append(row)
+
+    def event(self, name: str, **fields: Any) -> None:
+        """Non-per-video happenings (worker deaths, group fallbacks)."""
+        self._append({"event": name, **fields})
+
+    def _append(self, row: Dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            row = {
+                "ts": round(time.time(), 4),
+                "pid": os.getpid(),
+                "run": self.run_id,
+                "seq": self._seq,
+                **row,
+            }
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(json.dumps(row) + "\n")
+            self._fh.flush()
+
+
+class _NullManifest:
+    """No-op stand-in for external_call / print-mode ad-hoc runs."""
+
+    path = None
+    output_root = None
+
+    def record(self, *a: Any, **kw: Any) -> None:
+        pass
+
+    def event(self, *a: Any, **kw: Any) -> None:
+        pass
+
+
+NULL_MANIFEST = _NullManifest()
+
+
+def iter_manifest_records(output_root: str) -> List[Dict[str, Any]]:
+    """Every record from every process's (and prior run's) events file,
+    in (ts, pid, seq) order. Truncated trailing lines (a killed writer)
+    are skipped, never fatal."""
+    rows: List[Dict[str, Any]] = []
+    for path in glob.glob(os.path.join(manifest_dir(output_root), "events-*.jsonl")):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail of a killed writer
+        except OSError:
+            continue
+    rows.sort(key=lambda r: (r.get("ts", 0), r.get("pid", 0), r.get("seq", 0)))
+    return rows
+
+
+def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
+    """Fold every events file under ``output_root`` into one summary, or
+    None when no manifest exists (e.g. a print-mode run).
+
+    Per-video final status: the chronologically LAST terminal record
+    (done/failed) wins — so a retry that recovers reads 'done', a resume
+    run that re-fails reads 'failed', and a 'skipped' probe can never
+    demote an earlier 'done'. Videos with only non-terminal records
+    (skipped, retry) keep the last of those."""
+    records = iter_manifest_records(output_root)
+    if not records:
+        return None
+    videos: Dict[str, Dict[str, Any]] = {}
+    warnings: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    retries = 0
+    for r in records:
+        if "event" in r:
+            events.append(r)
+            continue
+        status = r.get("status")
+        if status == "warning":
+            warnings.append(r)
+            continue
+        if status == "retry":
+            retries += 1
+        key = r.get("video")
+        if key is None:
+            continue
+        cur = videos.setdefault(key, {"status": None})
+        cur["attempts"] = max(int(cur.get("attempts") or 0), int(r.get("attempts") or 0))
+        terminal = status in ("done", "failed")
+        if terminal or cur["status"] not in ("done", "failed"):
+            cur["status"] = status
+            for field in ("stage", "error_class", "error_type", "message", "wall_s"):
+                if field in r:
+                    cur[field] = r[field]
+                elif field in cur and terminal:
+                    del cur[field]
+    counts = {"done": 0, "failed": 0, "skipped": 0, "retry": 0, "other": 0}
+    for v in videos.values():
+        counts[v["status"] if v["status"] in counts else "other"] += 1
+    worker_deaths = [e for e in events if e.get("event") == "worker_death"]
+    return {
+        "videos": videos,
+        "total": len(videos),
+        "done": counts["done"],
+        "failed": counts["failed"],
+        "skipped": counts["skipped"],
+        "retries": retries,
+        "warnings": warnings,
+        "events": events,
+        "worker_deaths": worker_deaths,
+    }
+
+
+def finalize_run(output_root: str) -> Optional[Dict[str, Any]]:
+    """Merge and atomically write ``_manifest/summary.json`` (tmp +
+    rename: concurrent multi-host finalizers last-write-win a COMPLETE
+    file). Returns the summary, or None when there is no manifest."""
+    summary = merge_manifest(output_root)
+    if summary is None:
+        return None
+    path = os.path.join(manifest_dir(output_root), SUMMARY_BASENAME)
+    tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(summary, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return summary
+
+
+def format_summary(summary: Dict[str, Any]) -> str:
+    parts = [
+        f"run manifest: {summary['done']}/{summary['total']} done",
+        f"{summary['failed']} failed",
+        f"{summary['skipped']} skipped",
+        f"{summary['retries']} retries",
+    ]
+    if summary["warnings"]:
+        parts.append(f"{len(summary['warnings'])} warning(s)")
+    if summary["worker_deaths"]:
+        parts.append(f"{len(summary['worker_deaths'])} worker death(s)")
+    line = ", ".join(parts)
+    failed = [k for k, v in summary["videos"].items() if v["status"] == "failed"]
+    if failed:
+        shown = ", ".join(failed[:5]) + (", ..." if len(failed) > 5 else "")
+        line += f"\n  failed: {shown}"
+    return line
+
+
+def strict_failures(summary: Dict[str, Any]) -> List[str]:
+    """What ``--strict`` turns into a nonzero exit: failed videos,
+    empty-feature warnings, and worker deaths."""
+    problems = [
+        f"failed: {k} ({v.get('error_class', '?')}: {v.get('message', '')[:80]})"
+        for k, v in summary["videos"].items()
+        if v["status"] == "failed"
+    ]
+    problems += [f"warning: {w.get('message', '')[:120]}" for w in summary["warnings"]]
+    problems += [
+        f"worker death: {d.get('device', '?')}: {d.get('message', '')[:80]}"
+        for d in summary["worker_deaths"]
+    ]
+    return problems
+
+
+def permanently_failed_videos(output_root: str) -> set:
+    """Videos whose merged final status is a PERMANENT failure — the set
+    ``--resume`` skips unless ``--retry_failed`` (transient-exhausted
+    failures are re-attempted on resume by default: retrying may help,
+    that is what transient means)."""
+    summary = merge_manifest(output_root)
+    if summary is None:
+        return set()
+    return {
+        k
+        for k, v in summary["videos"].items()
+        if v["status"] == "failed" and v.get("error_class") == "permanent"
+    }
