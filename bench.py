@@ -962,6 +962,32 @@ def _sub_fault_overhead() -> dict:
     return out
 
 
+def _sub_analysis_overhead() -> dict:
+    """Wall-time of a full graftcheck sweep (docs/analysis.md): the
+    static-analysis suite is meant to run on every push via
+    scripts/check.sh, so it carries an explicit latency budget — a full
+    package lint (parse + host-sync + jit-hygiene + thread-safety over
+    every module) must stay under 5 s on one core. The budget is
+    reported here and pinned in-band so a checker that grows an
+    accidentally quadratic pass shows up as a bench regression."""
+    from video_features_tpu.analysis import run_checks
+
+    budget_s = 5.0
+    t0 = time.perf_counter()
+    findings = run_checks()
+    cold_s = time.perf_counter() - t0  # includes first-parse of the package
+    t0 = time.perf_counter()
+    run_checks()
+    warm_s = time.perf_counter() - t0
+    return {
+        "analysis_graftcheck_cold_s": round(cold_s, 3),
+        "analysis_graftcheck_warm_s": round(warm_s, 3),
+        "analysis_budget_s": budget_s,
+        "analysis_within_budget": cold_s < budget_s,
+        "analysis_findings": len(findings),  # 0 on a clean tree
+    }
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -977,6 +1003,7 @@ SUB_PARTS = {
     "pallas_corr": lambda: bench_pallas_corr(),
     "flash_attention": lambda: bench_flash_attention(),
     "fault_overhead": _sub_fault_overhead,
+    "analysis_overhead": _sub_analysis_overhead,
 }
 
 
@@ -1143,6 +1170,9 @@ def main() -> None:
     # pure-host like the pipeline part: the fault-tolerance bookkeeping
     # cost (fire() no-ops + manifest appends) vs the chip headline
     extra.update(_spawn_sub("fault_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
+    emit()
+    # graftcheck latency budget (pure host: AST only, no device work)
+    extra.update(_spawn_sub("analysis_overhead", 120.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
 
     if not _probe_backend(fatal=False):

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-shot static-quality gate: graftcheck lints + ruff + the analysis
+# test tier (seeded-violation fixtures and the GC401 recompilation
+# budget). Run from the repo root; exits nonzero on the first failing
+# gate. CI's lint job runs exactly this script.
+#
+#   ./scripts/check.sh            # everything
+#   SKIP_PYTEST=1 ./scripts/check.sh   # lints only (sub-second feedback)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftcheck (python -m video_features_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m video_features_tpu.analysis
+
+echo
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+  # config in pyproject.toml: pyflakes F + targeted bugbear subset
+  ruff check video_features_tpu tests bench.py main.py
+else
+  # this container ships without ruff (and pip installs are off); the
+  # config is committed so any env WITH ruff enforces it — CI does.
+  echo "ruff not on PATH — skipped (config: pyproject.toml [tool.ruff])"
+fi
+
+if [[ "${SKIP_PYTEST:-0}" != "1" ]]; then
+  echo
+  echo "== pytest -m analysis (fixtures + compile budget) =="
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+    -p no:cacheprovider -p no:randomly
+fi
+
+echo
+echo "check.sh: all gates passed"

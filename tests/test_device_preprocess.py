@@ -134,7 +134,7 @@ def mixed_videos(tmp_path_factory):
     ]
 
 
-def _clip_run(videos, tmp_path, preprocess, video_batch=1):
+def _clip_run(videos, tmp_path, preprocess, video_batch=1, **kw):
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
 
     cfg = ExtractionConfig(
@@ -147,16 +147,30 @@ def _clip_run(videos, tmp_path, preprocess, video_batch=1):
         tmp_path=str(tmp_path / "tmp"),
         output_path=str(tmp_path / "out"),
         cpu=True,
+        **kw,
     )
     return ExtractCLIP(cfg, external_call=True)()
 
 
 @pytest.fixture(scope="module")
-def clip_host_and_device(mixed_videos, tmp_path_factory):
+def clip_device_counted(mixed_videos, tmp_path_factory):
+    """The device run, traced by the GC401 compile counter: the SAME
+    extraction both the drift tests and the recompilation budget
+    (analysis/compile_budget.json) assert against."""
+    from video_features_tpu.analysis import CompileCounter
+
+    tmp = tmp_path_factory.mktemp("devpre_clip_dev")
+    with CompileCounter() as cc:
+        dev = _clip_run(mixed_videos, tmp, "device")
+    return dev, dict(cc.counts)
+
+
+@pytest.fixture(scope="module")
+def clip_host_and_device(mixed_videos, clip_device_counted, tmp_path_factory):
     tmp = tmp_path_factory.mktemp("devpre_clip")
     return (
         _clip_run(mixed_videos, tmp, "host"),
-        _clip_run(mixed_videos, tmp, "device"),
+        clip_device_counted[0],
     )
 
 
@@ -177,12 +191,43 @@ def test_clip_device_aggregation_matches_solo(
 ):
     """--video_batch with device preprocess: mixed resolutions split into
     per-bucket agg groups; fused results must match solo device results."""
+    from video_features_tpu.analysis import CompileCounter, assert_within_budget
+
     _, solo = clip_host_and_device
-    fused = _clip_run(mixed_videos, tmp_path, "device", video_batch=2)
+    with CompileCounter() as cc:
+        fused = _clip_run(mixed_videos, tmp_path, "device", video_batch=2)
     for s, f in zip(solo, fused):
         np.testing.assert_allclose(
             f["CLIP-ViT-B/32"], s["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
         )
+    assert_within_budget("clip_device_grouped", cc)
+
+
+@pytest.mark.analysis
+def test_clip_device_compile_budget(clip_device_counted):
+    """GC401: the mixed-resolution device run builds executables per
+    spatial bucket (2 here), never per video (3) — enforced against the
+    committed ceiling in analysis/compile_budget.json."""
+    from video_features_tpu.analysis import check_counts
+
+    _, counts = clip_device_counted
+    assert counts.get("encode_raw") == 2, counts
+    assert check_counts("clip_device_mixed", counts) == []
+
+
+@pytest.mark.analysis
+def test_broken_bucket_sharing_fails_budget(mixed_videos, tmp_path):
+    """Inflating the executable count must FAIL the budget: shrinking
+    --spatial_bucket to 8 splits the shared (256, 448) bucket, so each
+    of the 3 resolutions compiles its own encode_raw — 3 > the committed
+    ceiling of 2, and check_counts says so with the rule id."""
+    from video_features_tpu.analysis import CompileCounter, check_counts
+
+    with CompileCounter() as cc:
+        _clip_run(mixed_videos, tmp_path, "device", spatial_bucket=8)
+    assert cc.counts["encode_raw"] == 3, dict(cc.counts)
+    violations = check_counts("clip_device_mixed", dict(cc.counts))
+    assert violations and "GC401" in violations[0] and "encode_raw" in violations[0]
 
 
 def _resnet_cfg(videos, tmp_path, **kw):

@@ -1,0 +1,48 @@
+"""graftcheck — the repo's static-analysis suite (docs/analysis.md).
+
+Four checkers turn the design rules the hot path depends on into tier-1
+test failures instead of review-time folklore:
+
+- GC10x host-sync lint (:mod:`.hostsync`) — no hidden device->host
+  syncs inside the per-video hot loop.
+- GC20x jit-hygiene lint (:mod:`.jit_hygiene`) — jit closures stay
+  immutable, Python control flow stays off traced values, static-arg
+  declarations name real parameters.
+- GC301 thread-safety lint (:mod:`.thread_safety`) — module-level
+  mutable state on thread-reachable paths is locked, thread-local, or
+  explicitly waived.
+- GC401 recompilation budget (:mod:`.compile_budget`) — a runtime
+  tracer pins executable counts per extractor to
+  ``analysis/compile_budget.json``.
+
+Run ``python -m video_features_tpu.analysis`` (CLI) or
+``pytest -m analysis`` (tier-1). Waive individual findings with inline
+``# graftcheck: <rule> — reason`` comments; audit them all with
+``git grep 'graftcheck:'``.
+"""
+
+from video_features_tpu.analysis.compile_budget import (
+    CompileCounter,
+    assert_within_budget,
+    check_counts,
+    load_budget,
+)
+from video_features_tpu.analysis.core import (
+    Finding,
+    Rule,
+    all_rules,
+    collect_sources,
+    run_checks,
+)
+
+__all__ = [
+    "CompileCounter",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "assert_within_budget",
+    "check_counts",
+    "collect_sources",
+    "load_budget",
+    "run_checks",
+]

@@ -1,0 +1,70 @@
+"""CLI: ``python -m video_features_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error. Findings print as
+``file:line:col: GC### rule-name: message`` plus a fix hint — the format
+scripts/check.sh and CI grep. ``--json`` emits a machine-readable list.
+
+No jax import, no package import side effects beyond the analysis
+subpackage itself: the suite parses source, it never executes it (the
+GC401 runtime budget runs under pytest, not here — see
+``pytest -m analysis``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m video_features_tpu.analysis",
+        description="graftcheck: JAX/TPU static-analysis suite "
+        "(host-sync, jit-hygiene, thread-safety lints)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to check (default: the installed package)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="TOKEN",
+        help="only report rules matching TOKEN (id like GC301, or a "
+        "name prefix like host-sync); repeatable",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON findings")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    args = parser.parse_args(argv)
+
+    from video_features_tpu.analysis.core import all_rules, run_checks
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:<20} {rule.summary}")
+        return 0
+
+    try:
+        findings = run_checks(args.paths or None, rules=args.rule)
+    except (OSError, SyntaxError) as e:
+        print(f"graftcheck: cannot analyze: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        print(
+            f"graftcheck: {n} finding(s)"
+            if n
+            else "graftcheck: clean (waivers audited via `git grep graftcheck:`)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

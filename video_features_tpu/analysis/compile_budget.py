@@ -1,0 +1,138 @@
+"""GC401 — enforced recompilation budgets.
+
+PR 1/2's load-bearing invariant is that executables are shared per
+(spatial bucket, output grid): per-video geometry enters jitted programs
+as INPUTS, so a million-video corpus compiles a handful of programs, not
+one per source resolution. Until now that guarantee lived in comments.
+Here it is a regression-tested budget: :class:`CompileCounter` counts
+XLA executable builds per jitted-function name during the existing
+device-preprocess extraction scenarios, and ``compile_budget.json``
+commits the ceiling per scenario. Inflating the executable count for any
+device-preprocess extractor (e.g. breaking bucket sharing so each source
+resolution compiles its own ``encode_raw``) fails a tier-1 test
+(tests/test_compile_budget.py).
+
+The counter hooks ``jax_log_compiles``: with the flag up, jax logs one
+``Compiling <fn-name> with global shapes and types ...`` record per
+executable build through the ``jax._src.interpreters.pxla`` logger.
+Counting log records instead of wrapping internals keeps the tracer
+version-tolerant (the jax.monitoring duration events carry no function
+name); internal jit names (``convert_element_type`` et al.) show up in
+``counts`` but only names listed in a scenario's budget are enforced.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from collections import Counter
+from typing import Dict, List, Optional
+
+from video_features_tpu.analysis.core import Rule
+
+BUDGET_RULE = Rule(
+    "GC401", "compile-budget",
+    "executable count per extractor exceeds the committed budget",
+)
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "compile_budget.json")
+
+# "Compiling encode_raw with global shapes and types [...]" — emitted
+# once per executable BUILD (retraces included, cache hits of the same
+# trace excluded), which is exactly the fragmentation metric the budget
+# bounds.
+_COMPILING_RE = re.compile(r"^Compiling (\S+) with global shapes")
+_LOGGER_NAMES = ("jax._src.interpreters.pxla", "jax.interpreters.pxla")
+
+
+class CompileCounter(logging.Handler):
+    """Context manager counting executable builds per jitted-fn name.
+
+    >>> with CompileCounter() as cc:
+    ...     run_extraction()
+    >>> cc.counts["encode_raw"]
+    2
+    """
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.counts: Counter = Counter()
+        self._prev_flag: Optional[bool] = None
+
+    # logging.Handler interface
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILING_RE.match(record.getMessage())
+        except Exception:  # noqa: BLE001 - a broken record must not kill the run
+            return
+        if m:
+            self.counts[m.group(1)] += 1
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def __enter__(self) -> "CompileCounter":
+        import jax
+
+        self._prev_flag = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        for name in _LOGGER_NAMES:
+            logging.getLogger(name).addHandler(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        for name in _LOGGER_NAMES:
+            logging.getLogger(name).removeHandler(self)
+        jax.config.update("jax_log_compiles", bool(self._prev_flag))
+
+
+def load_budget(path: Optional[str] = None) -> Dict[str, dict]:
+    with open(path or BUDGET_PATH, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    return doc["scenarios"]
+
+
+def check_counts(
+    scenario: str, counts: Dict[str, int], path: Optional[str] = None
+) -> List[str]:
+    """Violation strings (empty = within budget) for ``counts`` measured
+    under the named scenario. Budgets are ceilings; a count of zero for a
+    budgeted name is ALSO a violation — it means the scenario no longer
+    exercises the executable it claims to pin, so the budget is dead."""
+    scenarios = load_budget(path)
+    if scenario not in scenarios:
+        return [
+            f"unknown compile-budget scenario {scenario!r} "
+            f"(known: {', '.join(sorted(scenarios))})"
+        ]
+    spec = scenarios[scenario]
+    out: List[str] = []
+    for name, ceiling in spec["max_compiles"].items():
+        got = counts.get(name, 0)
+        if got > ceiling:
+            out.append(
+                f"[GC401 compile-budget] {scenario}: {name!r} built {got} "
+                f"executables, budget is {ceiling} — per-video state is "
+                f"leaking into trace-time (bucket sharing broken?)"
+            )
+        elif got == 0:
+            out.append(
+                f"[GC401 compile-budget] {scenario}: {name!r} compiled 0 times "
+                f"— the scenario no longer exercises this executable; update "
+                f"compile_budget.json"
+            )
+    return out
+
+
+def assert_within_budget(
+    scenario: str, counter: CompileCounter, path: Optional[str] = None
+) -> None:
+    violations = check_counts(scenario, dict(counter.counts), path)
+    if violations:
+        raise AssertionError("\n".join(violations))

@@ -1,0 +1,383 @@
+"""graftcheck core: findings, waivers, the source-file model, the runner.
+
+The suite is AST-based and import-free: every checker works on parsed
+source (``ast`` + ``tokenize``), so ``python -m video_features_tpu.analysis``
+never executes the code it audits and runs in well under the 5 s budget
+bench.py's ``analysis_overhead`` part enforces (docs/analysis.md).
+
+Waiver contract: a ``# graftcheck: <token>[, <token>...] — reason``
+comment on the offending line (or on a standalone comment line directly
+above it) suppresses matching findings. A token matches a rule when it
+equals the rule id (``GC301``) or is a prefix of the rule name
+(``unlocked`` waives ``unlocked-global``; ``host-sync`` waives the whole
+GC10x family). ``git grep 'graftcheck:'`` audits every waiver in one
+sweep — that greppability is the reason waivers are inline comments and
+not a config file.
+
+Two file-level markers ride the same comment syntax:
+
+- ``# graftcheck: hot-module`` — opt a file into the host-sync lint's
+  hot set beyond the built-in path patterns (used by test fixtures).
+- ``# graftcheck: thread-root`` — declare a file a thread-spawning root
+  for the thread-safety reachability walk.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import io
+import os
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str  # "GC101"
+    name: str  # "host-sync-item"
+    summary: str
+
+    def matches_token(self, token: str) -> bool:
+        t = token.strip().lower()
+        if not t:
+            return False
+        return t == self.id.lower() or self.name.startswith(t)
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: Rule
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: {self.rule.id} {self.rule.name}: {self.message}"
+        if self.hint:
+            s += f"\n    fix: {self.hint}"
+        return s
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule.id,
+            "name": self.rule.name,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+# Paths (relative to the package root) the host-sync lint treats as the
+# per-video hot loop: a device->host sync here stalls the dispatch
+# pipeline once per video (or worse, once per frame batch).
+HOT_MODULE_PATTERNS = (
+    "extract/*.py",
+    "ops/*.py",
+    "ops/*/*.py",
+    "models/*/model.py",
+)
+
+# Thread-spawning roots for the thread-safety reachability walk: the
+# modules that create or run on worker threads (ISSUE 4 tentpole set).
+THREAD_ROOT_PATTERNS = (
+    "parallel/scheduler.py",
+    "extract/base.py",
+    "runtime/faults.py",
+    "io/sink.py",
+    "native/__init__.py",
+    "utils/profiling.py",
+)
+
+
+class SourceFile:
+    """One parsed module: AST + waiver map + file-level markers."""
+
+    def __init__(self, path: str, text: str, rel: Optional[str] = None) -> None:
+        self.path = path
+        self.text = text
+        # rel: package-relative posix path ("extract/base.py") used for
+        # hot/root pattern matching; falls back to the basename.
+        self.rel = rel if rel is not None else os.path.basename(path)
+        self.tree = ast.parse(text, filename=path)
+        # line -> waiver tokens on that line; a standalone waiver comment
+        # also registers for the next line.
+        self.waivers: Dict[int, Set[str]] = {}
+        self.markers: Set[str] = set()
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                body = tok.string.lstrip("#").strip()
+                if not body.lower().startswith("graftcheck:"):
+                    continue
+                spec = body[len("graftcheck:"):].strip()
+                # strip a trailing "— reason" / "- reason" clause
+                for dash in ("—", " - ", " -- "):
+                    if dash in spec:
+                        spec = spec.split(dash, 1)[0]
+                tokens_ = {t.strip().lower() for t in spec.split(",") if t.strip()}
+                if not tokens_:
+                    continue
+                self.markers |= {t for t in tokens_ if t in ("hot-module", "thread-root")}
+                line = tok.start[0]
+                self.waivers.setdefault(line, set()).update(tokens_)
+                # a comment-only line waives the statement it precedes:
+                # the reason clause may wrap onto further comment lines,
+                # so carry the waiver to the first following code line
+                lines = self.text.splitlines()
+                prefix = lines[line - 1][: tok.start[1]]
+                if not prefix.strip():
+                    nxt = line  # 0-based index of the line after the comment
+                    while nxt < len(lines) and (
+                        not lines[nxt].strip() or lines[nxt].lstrip().startswith("#")
+                    ):
+                        nxt += 1
+                    self.waivers.setdefault(nxt + 1, set()).update(tokens_)
+        except tokenize.TokenError:
+            pass
+
+    def waived(self, line: int, rule: Rule) -> bool:
+        return any(rule.matches_token(t) for t in self.waivers.get(line, ()))
+
+    @property
+    def is_hot(self) -> bool:
+        if "hot-module" in self.markers:
+            return True
+        return any(fnmatch.fnmatch(self.rel, pat) for pat in HOT_MODULE_PATTERNS)
+
+    @property
+    def is_thread_root(self) -> bool:
+        if "thread-root" in self.markers:
+            return True
+        return any(fnmatch.fnmatch(self.rel, pat) for pat in THREAD_ROOT_PATTERNS)
+
+    @property
+    def module_name(self) -> str:
+        return self.rel[:-3].replace("/", ".") if self.rel.endswith(".py") else self.rel
+
+
+def package_root() -> str:
+    """The installed video_features_tpu package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def collect_sources(paths: Optional[Sequence[str]] = None) -> List[SourceFile]:
+    """Load every .py under ``paths`` (default: the package itself) into
+    SourceFiles with package-relative names for pattern matching."""
+    roots = [package_root()] if not paths else [os.path.abspath(p) for p in paths]
+    out: List[SourceFile] = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(_load(root, _pattern_rel(root, os.path.basename(root))))
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in ("__pycache__", "_build")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                out.append(_load(full, _pattern_rel(full, rel)))
+    return out
+
+
+def _pattern_rel(full: str, fallback: str) -> str:
+    # explicit file/dir args may point INSIDE the package
+    # (``graftcheck video_features_tpu/extract/base.py``): the hot/root
+    # patterns are package-relative, so recover the tail from the full
+    # path whenever it names the package dir
+    posix = full.replace(os.sep, "/")
+    return posix if "video_features_tpu/" in posix else fallback
+
+
+def _load(path: str, rel: str) -> SourceFile:
+    # checks run equally from the package dir or the repo root: pattern
+    # matching always sees the package-relative tail
+    if "video_features_tpu/" in rel:
+        rel = rel.rsplit("video_features_tpu/", 1)[1]
+    with open(path, "r", encoding="utf-8") as f:
+        return SourceFile(path, f.read(), rel)
+
+
+# --- shared AST helpers -----------------------------------------------------
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """name -> dotted module/attr it refers to, from every import in the
+    tree (module- and function-level): ``import numpy as np`` -> np:
+    numpy; ``from jax import numpy as jnp`` -> jnp: jax.numpy."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); None for anything
+    not a plain dotted chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted name with the import-alias head expanded: ``_np.asarray``
+    -> ``numpy.asarray`` when ``import numpy as _np``."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    base = aliases.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+def is_jax_jit(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    rd = resolve_dotted(node, aliases)
+    return rd in ("jax.jit", "jax.api.jit") or (
+        rd is not None and rd.endswith(".jit") and rd.startswith("jax")
+    )
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit`` application: the node it decorates/wraps plus the
+    static-argument declarations attached at the site."""
+
+    node: ast.AST  # the jit call/decorator expression (for line info)
+    func: Optional[ast.FunctionDef]  # the jitted def, when resolvable
+    static_argnames: List[str]
+    static_argnums: List[int]
+    has_unknown_kwargs: bool  # **kwargs at the site: skip static checks
+
+
+def _static_decls(call: ast.Call) -> Tuple[List[str], List[int], bool]:
+    names: List[str] = []
+    nums: List[int] = []
+    unknown = False
+    for kw in call.keywords:
+        if kw.arg is None:
+            unknown = True
+        elif kw.arg == "static_argnames":
+            names.extend(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            nums.extend(_const_ints(kw.value))
+    return names, nums, unknown
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+        return out
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, int)
+        ]
+    return []
+
+
+def jit_decoration(
+    fn: ast.FunctionDef, aliases: Dict[str, str]
+) -> Optional[JitSite]:
+    """The JitSite for a ``@jax.jit`` / ``@partial(jax.jit, ...)``
+    decorated def, or None."""
+    for dec in fn.decorator_list:
+        if is_jax_jit(dec, aliases):
+            return JitSite(dec, fn, [], [], False)
+        if isinstance(dec, ast.Call):
+            callee = resolve_dotted(dec.func, aliases)
+            if callee in ("functools.partial", "partial") and dec.args:
+                if is_jax_jit(dec.args[0], aliases):
+                    names, nums, unknown = _static_decls(dec)
+                    return JitSite(dec, fn, names, nums, unknown)
+            elif is_jax_jit(dec.func, aliases):
+                # @jax.jit(static_argnames=...) direct-call form
+                names, nums, unknown = _static_decls(dec)
+                return JitSite(dec, fn, names, nums, unknown)
+    return None
+
+
+def param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+# --- runner -----------------------------------------------------------------
+
+def run_checks(
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run every static checker over ``paths`` (default: the installed
+    package), drop waived findings, return the rest sorted by location.
+    ``rules`` filters to findings whose rule id/name matches any token."""
+    from video_features_tpu.analysis import hostsync, jit_hygiene, thread_safety
+
+    sources = collect_sources(paths)
+    findings: List[Finding] = []
+    for src in sources:
+        if src.is_hot:
+            findings.extend(hostsync.check(src))
+        findings.extend(jit_hygiene.check(src))
+    findings.extend(thread_safety.check(sources))
+
+    kept = []
+    for f in findings:
+        src = next((s for s in sources if s.path == f.path), None)
+        if src is not None and src.waived(f.line, f.rule):
+            continue
+        if rules and not any(f.rule.matches_token(t) for t in rules):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule.id))
+    return kept
+
+
+def all_rules() -> List[Rule]:
+    from video_features_tpu.analysis import hostsync, jit_hygiene, thread_safety
+    from video_features_tpu.analysis.compile_budget import BUDGET_RULE
+
+    return [
+        *hostsync.RULES.values(),
+        *jit_hygiene.RULES.values(),
+        thread_safety.RULE,
+        BUDGET_RULE,
+    ]

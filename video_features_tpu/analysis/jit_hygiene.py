@@ -1,0 +1,419 @@
+"""GC20x — jit-hygiene lint.
+
+VirtualFlow's core argument (PAPERS.md) is that retracing/recompilation
+cost dominates when model shapes leak into executables; this package's
+answer is bucketed static shapes with everything per-video entering as
+jit INPUTS. Three bug classes silently break that contract:
+
+- **GC201 jit-mutable-closure** — a jitted function closing over a
+  mutable value (list/dict/set, or a name rebound after the def) bakes
+  trace-time state into the executable: later mutations are invisible,
+  or worse, force retraces that fragment the executable cache.
+- **GC202 jit-traced-branch** — Python ``if``/``while`` on a traced
+  parameter either raises a ``TracerBoolConversionError`` at runtime or,
+  with the parameter later made static, compiles one executable per
+  VALUE — the per-resolution fragmentation the recompilation budget
+  (analysis/compile_budget.py) exists to catch. Shape/dtype attribute
+  branches (``x.ndim``, ``x.shape``, ``x.dtype``) are trace-time static
+  and allowed; so are ``is None`` sentinels.
+- **GC203 jit-static-args** — ``static_argnames`` naming a parameter
+  that does not exist (or ``static_argnums`` out of range) silently
+  declares nothing static; the call then traces the argument it was
+  supposed to specialize on.
+
+Sites covered: ``@jax.jit``, ``@partial(jax.jit, ...)`` decorators and
+``jax.jit(fn, ...)`` call forms where ``fn`` resolves to a def in the
+same module. Sites with ``**kwargs`` skip the static-decl checks (the
+declaration is not statically visible).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from video_features_tpu.analysis.core import (
+    Finding,
+    JitSite,
+    Rule,
+    SourceFile,
+    _static_decls,
+    import_aliases,
+    is_jax_jit,
+    jit_decoration,
+    param_names,
+)
+
+RULES = {
+    "GC201": Rule(
+        "GC201", "jit-mutable-closure",
+        "jitted function captures a mutable/rebound value",
+    ),
+    "GC202": Rule(
+        "GC202", "jit-traced-branch",
+        "Python if/while branches on a traced parameter",
+    ),
+    "GC203": Rule(
+        "GC203", "jit-static-args",
+        "static_argnums/argnames must name real parameters",
+    ),
+}
+
+# attributes of a traced array that are static at trace time — branching
+# on them selects an executable, it does not trace a value
+_STATIC_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "sharding", "aval", "weak_type", "itemsize"}
+)
+_MUTATING_METHODS = frozenset(
+    {"append", "extend", "insert", "update", "add", "setdefault", "pop",
+     "popitem", "clear", "remove", "discard"}
+)
+
+
+def check(src: SourceFile) -> List[Finding]:
+    aliases = import_aliases(src.tree)
+    findings: List[Finding] = []
+
+    # walk with an explicit enclosing-function stack so closure captures
+    # can be resolved against the scopes that actually bind them; each
+    # scope is flattened through its compound statements (defs commonly
+    # live under ``if``/``with`` blocks) without entering nested defs
+    def visit(body: List[ast.stmt], scopes: List[ast.FunctionDef]) -> None:
+        local_defs: Dict[str, ast.FunctionDef] = {}
+        defs: List[ast.FunctionDef] = []
+        stmts: List[ast.stmt] = []
+
+        def flatten(b):
+            for st in b:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.append(st)
+                    local_defs[st.name] = st
+                    continue
+                if isinstance(st, ast.ClassDef):
+                    flatten(st.body)  # methods close over the same scopes
+                    continue
+                stmts.append(st)
+                for field in ("body", "orelse", "finalbody"):
+                    flatten(getattr(st, field, []) or [])
+                for h in getattr(st, "handlers", []) or []:
+                    flatten(h.body)
+                for case in getattr(st, "cases", []) or []:
+                    flatten(case.body)
+
+        flatten(body)
+        for st in stmts:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(
+                    child,
+                    (ast.stmt, ast.excepthandler, ast.FunctionDef,
+                     ast.AsyncFunctionDef),
+                ) or type(child).__name__ == "match_case":
+                    continue
+                for node in ast.walk(child):
+                    if isinstance(node, ast.Call) and is_jax_jit(node.func, aliases):
+                        site = _call_site(node, local_defs)
+                        if site is not None:
+                            check_site(site, scopes)
+        for d in defs:
+            site = jit_decoration(d, aliases)
+            if site is not None:
+                check_site(site, scopes)
+            visit(d.body, scopes + [d])
+
+    def _call_site(
+        node: ast.Call, local_defs: Dict[str, ast.FunctionDef]
+    ) -> Optional[JitSite]:
+        names, nums, unknown = _static_decls(node)
+        fn = None
+        if node.args and isinstance(node.args[0], ast.Name):
+            fn = local_defs.get(node.args[0].id)
+        if fn is None and not names and not nums:
+            return None  # nothing checkable: unknown target, no decls
+        return JitSite(node, fn, names, nums, unknown)
+
+    def check_site(site: JitSite, scopes: List[ast.FunctionDef]) -> None:
+        fn = site.func
+        if fn is not None and not site.has_unknown_kwargs:
+            params = param_names(fn)
+            for name in site.static_argnames:
+                if name not in params:
+                    findings.append(
+                        Finding(
+                            src.path, site.node.lineno, site.node.col_offset,
+                            RULES["GC203"],
+                            f"static_argnames names {name!r} which is not a "
+                            f"parameter of {fn.name!r} (has: {', '.join(params)})",
+                            "rename the entry to an actual parameter, or drop it",
+                        )
+                    )
+            n_pos = len(fn.args.posonlyargs) + len(fn.args.args)
+            for num in site.static_argnums:
+                if num >= n_pos or num < -n_pos:
+                    findings.append(
+                        Finding(
+                            src.path, site.node.lineno, site.node.col_offset,
+                            RULES["GC203"],
+                            f"static_argnums {num} is out of range for "
+                            f"{fn.name!r} ({n_pos} positional parameter(s))",
+                            "point static_argnums at a real positional parameter",
+                        )
+                    )
+        if fn is None:
+            return
+        _check_traced_branches(fn, site)
+        if scopes:
+            _check_mutable_closure(fn, scopes)
+
+    def _check_traced_branches(fn: ast.FunctionDef, site: JitSite) -> None:
+        static: Set[str] = set(site.static_argnames)
+        pos = fn.args.posonlyargs + fn.args.args
+        n_pos = len(pos)
+        for num in site.static_argnums:
+            if -n_pos <= num < n_pos:
+                static.add(pos[num].arg)
+        traced = [p for p in param_names(fn) if p not in static]
+        if not traced:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            else:
+                continue
+            bad = _traced_name_in_test(test, traced)
+            if bad is not None:
+                kind = type(node).__name__.lower()
+                findings.append(
+                    Finding(
+                        src.path, test.lineno, test.col_offset, RULES["GC202"],
+                        f"{kind} test reads traced parameter {bad!r} inside "
+                        f"jitted {fn.name!r}",
+                        "use jnp.where/lax.cond/lax.while_loop, or declare the "
+                        "parameter static (and accept one executable per value)",
+                    )
+                )
+
+    def _check_mutable_closure(
+        fn: ast.FunctionDef, scopes: List[ast.FunctionDef]
+    ) -> None:
+        captured = _free_names(fn)
+        if not captured:
+            return
+        for scope in reversed(scopes):
+            binds, reasons = _scope_bindings(scope, fn)
+            for name in sorted(captured & set(binds)):
+                reason = reasons.get(name)
+                if reason is not None:
+                    findings.append(
+                        Finding(
+                            src.path, fn.lineno, fn.col_offset, RULES["GC201"],
+                            f"jitted {fn.name!r} captures {name!r} from "
+                            f"{scope.name!r}, which {reason}",
+                            "pass the value as a (static_*) argument, or bind "
+                            "an immutable snapshot before the def",
+                        )
+                    )
+            captured -= set(binds)
+
+    visit(src.tree.body, [])
+    return findings
+
+
+def _traced_name_in_test(test: ast.AST, traced: List[str]) -> Optional[str]:
+    """The first traced parameter whose VALUE the test converts to a
+    Python bool; None when every occurrence is trace-time static."""
+    ok_nodes: Set[int] = set()
+    for node in ast.walk(test):
+        # x.ndim / x.shape / x.dtype ... : static under tracing
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            for sub in ast.walk(node.value):
+                ok_nodes.add(id(sub))
+        # len(x) raises on tracers already caught elsewhere; isinstance()
+        # and `x is None` / `x is not None` are identity, not value
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for sub in ast.walk(node):
+                ok_nodes.add(id(sub))
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            if fname in ("isinstance", "len", "getattr", "hasattr", "callable"):
+                for sub in ast.walk(node):
+                    ok_nodes.add(id(sub))
+    for node in ast.walk(test):
+        if (
+            isinstance(node, ast.Name)
+            and node.id in traced
+            and id(node) not in ok_nodes
+        ):
+            return node.id
+    return None
+
+
+def _free_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names ``fn`` loads but does not bind itself (params, locals,
+    imports, nested defs all bind)."""
+    bound: Set[str] = set(param_names(fn))
+    loads: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            bound.add(node.name)
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+            else:
+                loads.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                bound.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, (ast.comprehension,)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+    return loads - bound
+
+
+def _scope_bindings(
+    scope: ast.FunctionDef, jitted: ast.FunctionDef
+) -> Tuple[Set[str], Dict[str, str]]:
+    """Names bound in ``scope`` (params + assigned locals), and for each
+    a reason string when capturing it from a jitted def is unsafe."""
+    binds: Set[str] = set(param_names(scope))
+    reasons: Dict[str, str] = {}
+
+    def note(name: str, reason: str) -> None:
+        reasons.setdefault(name, reason)
+
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not scope:
+            binds.add(node.name)
+            if node is jitted:
+                continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in _names_of(t):
+                    binds.add(n)
+                    if _is_mutable_literal(node.value):
+                        note(n, "is bound to a mutable literal")
+                    if (
+                        node.lineno > jitted.lineno
+                        and n != jitted.name
+                        and _reaches(scope, jitted, node)
+                    ):
+                        note(n, f"is rebound after the def (line {node.lineno})")
+        elif isinstance(node, ast.AugAssign):
+            for n in _names_of(node.target):
+                binds.add(n)
+                note(n, "is mutated with an augmented assignment")
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            for n in _names_of(node.target):
+                binds.add(n)
+                if _is_mutable_literal(node.value):
+                    note(n, "is bound to a mutable literal")
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATING_METHODS and isinstance(
+                node.func.value, ast.Name
+            ):
+                note(node.func.value.id, f"is mutated via .{node.func.attr}()")
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Store):
+            if isinstance(node.value, ast.Name):
+                note(node.value.id, "is mutated via item assignment")
+        elif isinstance(node, ast.For):
+            for n in _names_of(node.target):
+                binds.add(n)
+                if node.lineno < jitted.lineno:
+                    # a def INSIDE a for loop capturing the loop variable
+                    # is the classic late-binding bug; only flag when the
+                    # jitted def is lexically inside the loop body
+                    if _contains(node, jitted):
+                        note(n, "is a loop variable (late binding)")
+    return binds, reasons
+
+
+def _names_of(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in t.elts:
+            out.extend(_names_of(el))
+        return out
+    if isinstance(t, ast.Starred):
+        return _names_of(t.value)
+    return []
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                         ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("list", "dict", "set", "defaultdict", "deque",
+                                "Counter", "OrderedDict", "bytearray")
+    return False
+
+
+def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(n is inner for n in ast.walk(outer))
+
+
+def _suites_of(st: ast.stmt) -> List[List[ast.stmt]]:
+    out: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        suite = getattr(st, field, None)
+        if suite:
+            out.append(suite)
+    for h in getattr(st, "handlers", []) or []:
+        out.append(h.body)
+    for case in getattr(st, "cases", []) or []:
+        out.append(case.body)
+    return out
+
+
+def _suite_path(
+    scope: ast.FunctionDef, jitted: ast.FunctionDef
+) -> List[Tuple[List[ast.stmt], int]]:
+    """(suite, index) chain from ``scope.body`` down to the suite holding
+    ``jitted`` directly; empty when the def isn't lexically in scope."""
+
+    def search(suite: List[ast.stmt]) -> Optional[List[Tuple[List[ast.stmt], int]]]:
+        for i, st in enumerate(suite):
+            if st is jitted:
+                return [(suite, i)]
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope: its suites are not this flow
+            if _contains(st, jitted):
+                for sub in _suites_of(st):
+                    hit = search(sub)
+                    if hit is not None:
+                        return [(suite, i)] + hit
+                return None
+        return None
+
+    return search(scope.body) or []
+
+
+def _reaches(scope: ast.FunctionDef, jitted: ast.FunctionDef,
+             rebind: ast.AST) -> bool:
+    """Whether control can flow from the ``jitted`` def to ``rebind``.
+
+    Walks each enclosing suite outward from the def; a bare
+    ``return``/``raise`` met before the rebind means everything after it
+    (in this suite and all outer ones) is unreachable from that branch —
+    the mutually-exclusive-branch pattern (mesh vs single-device fn
+    factories ending in ``return fns``) is not a capture hazard.
+    Conditional terminals (``if ...: return``) fall through, keeping the
+    check conservative."""
+    path = _suite_path(scope, jitted)
+    if not path:
+        return True  # couldn't place the def: assume reachable
+    for suite, idx in reversed(path):
+        for st in suite[idx + 1:]:
+            if st is rebind or _contains(st, rebind):
+                return True
+            if isinstance(st, (ast.Return, ast.Raise)):
+                return False
+    return False

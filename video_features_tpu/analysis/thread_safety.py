@@ -1,0 +1,313 @@
+"""GC301 — thread-safety lint for module-level mutable state.
+
+The runtime is threads all the way down (one host thread per device,
+``--decode_workers`` prepare pools, native preprocess threads), so any
+module-level mutable binding written from a function is a data race
+UNLESS the write is (a) inside a ``with <lock>`` where the lock is a
+module-level ``threading.Lock/RLock/Condition``, (b) the binding is
+``threading.local()``, or (c) the line carries an explicit
+``# graftcheck: unlocked`` waiver stating why the race is benign (e.g.
+config-set-once before any worker thread exists).
+
+Scope: modules *reachable from the thread roots* — the six modules that
+spawn or run on worker threads (core.THREAD_ROOT_PATTERNS) — where
+"reachable" is the union of (1) modules the roots transitively import
+(code the threads call into) and (2) modules that transitively import a
+root (extractors subclass ``extract.base`` and their methods run ON the
+worker threads), closed over imports again. Import-time writes (module
+body statements) are exempt: the import lock serializes them.
+
+Read-only module tables (``CONFIGS``, ``WEIGHT_FILES``) never trip the
+rule — only names written from function bodies are considered state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from video_features_tpu.analysis.core import (
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+    import_aliases,
+    resolve_dotted,
+)
+
+RULE = Rule(
+    "GC301", "unlocked-global",
+    "module-level mutable state written without a lock on a thread-reachable path",
+)
+
+_LOCK_CALLS = frozenset(
+    {"threading.Lock", "threading.RLock", "threading.Condition",
+     "threading.Semaphore", "threading.BoundedSemaphore",
+     "multiprocessing.Lock", "multiprocessing.RLock"}
+)
+_LOCAL_CALLS = frozenset({"threading.local"})
+_MUTABLE_CALLS = frozenset(
+    {"list", "dict", "set", "collections.defaultdict", "defaultdict",
+     "collections.deque", "deque", "collections.Counter", "Counter",
+     "collections.OrderedDict", "OrderedDict", "bytearray"}
+)
+_MUTATING_METHODS = frozenset(
+    {"append", "extend", "insert", "update", "add", "setdefault", "pop",
+     "popitem", "clear", "remove", "discard"}
+)
+
+
+class _ModuleInfo:
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.aliases = import_aliases(src.tree)
+        self.imports = self._imported_modules()
+        self.locks, self.locals_, self.mutables = self._module_bindings()
+
+    def _imported_modules(self) -> Set[str]:
+        mods: Set[str] = set()
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mods.add(a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module:
+                    mods.add(node.module)
+                    for a in node.names:
+                        # "from pkg.io import sink" imports module pkg.io.sink
+                        mods.add(f"{node.module}.{a.name}")
+        return mods
+
+    def _module_bindings(self) -> Tuple[Set[str], Set[str], Set[str]]:
+        locks: Set[str] = set()
+        locals_: Set[str] = set()
+        mutables: Set[str] = set()
+        for st in self.src.tree.body:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(st, ast.Assign):
+                targets, value = st.targets, st.value
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                targets, value = [st.target], st.value
+            else:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names or value is None:
+                continue
+            if isinstance(value, ast.Call):
+                callee = resolve_dotted(value.func, self.aliases)
+                if callee in _LOCK_CALLS:
+                    locks.update(names)
+                    continue
+                if callee in _LOCAL_CALLS:
+                    locals_.update(names)
+                    continue
+                if callee in _MUTABLE_CALLS:
+                    mutables.update(names)
+                    continue
+            if isinstance(
+                value,
+                (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp),
+            ):
+                mutables.update(names)
+        return locks, locals_, mutables
+
+
+def _module_candidates(info: _ModuleInfo) -> Set[str]:
+    """Dotted-name suffixes this module answers to, so imports match
+    whether written package-absolute or tested from a fixture dir."""
+    name = info.src.module_name
+    out = {name}
+    parts = name.split(".")
+    for i in range(1, len(parts)):
+        out.add(".".join(parts[i:]))
+    if parts[-1] == "__init__":
+        pkg = ".".join(parts[:-1])
+        if pkg:
+            out.add(pkg)
+            pp = pkg.split(".")
+            for i in range(1, len(pp)):
+                out.add(".".join(pp[i:]))
+    return out
+
+
+def check(sources: Sequence[SourceFile]) -> List[Finding]:
+    infos = [_ModuleInfo(s) for s in sources]
+    by_suffix: Dict[str, _ModuleInfo] = {}
+    for info in infos:
+        for cand in _module_candidates(info):
+            by_suffix.setdefault(cand, info)
+
+    def resolve_import(mod: str) -> Optional[_ModuleInfo]:
+        # longest-suffix match: "video_features_tpu.io.sink" and "io.sink"
+        # both land on io/sink.py
+        parts = mod.split(".")
+        for i in range(len(parts)):
+            hit = by_suffix.get(".".join(parts[i:]))
+            if hit is not None:
+                return hit
+        return None
+
+    # edges in both directions of interest
+    imports_of: Dict[int, Set[int]] = {}
+    for idx, info in enumerate(infos):
+        tgt: Set[int] = set()
+        for mod in info.imports:
+            hit = resolve_import(mod)
+            if hit is not None and hit is not info:
+                tgt.add(infos.index(hit))
+        imports_of[idx] = tgt
+
+    roots = {i for i, info in enumerate(infos) if info.src.is_thread_root}
+    # (1) everything the roots call into
+    reachable = set(roots)
+    frontier = set(roots)
+    while frontier:
+        nxt = set()
+        for i in frontier:
+            nxt |= imports_of[i] - reachable
+        reachable |= nxt
+        frontier = nxt
+    # (2) modules that run on the threads by importing a root (extractor
+    # subclasses etc.), closed over THEIR imports too
+    importers = {
+        i for i in range(len(infos)) if imports_of[i] & roots
+    }
+    frontier = importers - reachable
+    reachable |= importers
+    while frontier:
+        nxt = set()
+        for i in frontier:
+            nxt |= imports_of[i] - reachable
+        reachable |= nxt
+        frontier = nxt
+
+    findings: List[Finding] = []
+    for i in sorted(reachable):
+        findings.extend(_check_module(infos[i]))
+    return findings
+
+
+def _check_module(info: _ModuleInfo) -> List[Finding]:
+    src = info.src
+    findings: List[Finding] = []
+    module_names = info.mutables | {
+        n
+        for fn in _functions(src.tree)
+        for n in _global_decls(fn)
+    }
+    if not module_names and not info.mutables:
+        return findings
+
+    for fn in _functions(src.tree):
+        globals_here = _global_decls(fn)
+        watched = (info.mutables | globals_here) - info.locals_
+        if not watched:
+            continue
+        for write_line, write_col, name, how, guarded in _writes(
+            fn, watched, globals_here, info
+        ):
+            if guarded:
+                continue
+            findings.append(
+                Finding(
+                    src.path, write_line, write_col, RULE,
+                    f"{how} of module-level {name!r} in {fn.name!r} without "
+                    f"holding a module lock",
+                    "guard with `with <module lock>:`, make it threading.local(), "
+                    "or waive with `# graftcheck: unlocked — <why it is safe>`",
+                )
+            )
+    return findings
+
+
+def _functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    return [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _global_decls(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _writes(fn, watched: Set[str], globals_here: Set[str], info: _ModuleInfo):
+    """(line, col, name, kind, guarded) for every write to a watched
+    module-level name in ``fn``. Guarded = lexically inside a ``with``
+    over a module-level lock."""
+    lock_names = info.locks
+
+    def walk(node: ast.AST, under_lock: bool):
+        if isinstance(node, ast.With):
+            locked = under_lock or any(
+                _is_lock_expr(item.context_expr, lock_names)
+                for item in node.items
+            )
+            for st in node.body:
+                walk(st, locked)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            # nested defs: globals they declare are checked when _functions
+            # visits them; their lock context is their call site's, which
+            # is unknowable statically — treat as unguarded there.
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                yield from _target_writes(t, node, under_lock)
+        elif isinstance(node, ast.AugAssign):
+            yield from _target_writes(node.target, node, under_lock)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            yield from _target_writes(node.target, node, under_lock)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if (
+                node.func.attr in _MUTATING_METHODS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in watched
+            ):
+                yield (
+                    node.lineno, node.col_offset, node.func.value.id,
+                    f".{node.func.attr}() mutation", under_lock,
+                )
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from walk(child, under_lock)
+
+    def _target_writes(t: ast.AST, node: ast.AST, under_lock: bool):
+        if isinstance(t, ast.Name):
+            # a plain rebind counts only when the name is module-global
+            # here (declared ``global``); otherwise it's a local shadow
+            if t.id in globals_here and t.id in watched | globals_here:
+                yield (node.lineno, node.col_offset, t.id, "rebind", under_lock)
+        elif isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+            if t.value.id in watched:
+                yield (
+                    node.lineno, node.col_offset, t.value.id,
+                    "item assignment", under_lock,
+                )
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                yield from _target_writes(el, node, under_lock)
+
+    for st in fn.body:
+        yield from walk(st, False)
+
+
+def _is_lock_expr(expr: ast.AST, lock_names: Set[str]) -> bool:
+    dn = dotted_name(expr)
+    if dn is None:
+        return False
+    head = dn.split(".")[0]
+    # Name('_lock'), or conservative: any dotted chain ending in a
+    # module-level lock name (cls._lock) or containing 'lock'
+    return (
+        head in lock_names
+        or dn.split(".")[-1] in lock_names
+        or "lock" in dn.split(".")[-1].lower()
+    )

@@ -153,6 +153,9 @@ class BaseExtractor:
         if multihost() and self.config.sharding == "mesh":
             from jax.experimental import multihost_utils
 
+            # graftcheck: host-sync — the blocking collective IS the point:
+            # every process must agree on the skip decision before any of
+            # them dispatches, so this sync sits outside the hot loop
             done = bool(
                 multihost_utils.broadcast_one_to_all(np.int32(done))
             )

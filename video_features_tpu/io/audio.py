@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 from typing import Dict, Tuple
 
 import numpy as np
@@ -64,7 +65,10 @@ def _sinc_window() -> np.ndarray:
 
 
 # (src_sr, dst_sr) -> (per-phase weight matrix rows, left extents, window len)
+# VGGish prepare runs on --decode_workers threads, so the cache insert is
+# lock-guarded; a racing miss at worst recomputes the same taps.
 _PHASE_CACHE: Dict[Tuple[int, int], tuple] = {}
+_PHASE_LOCK = threading.Lock()
 
 
 def _phase_filters(src_sr: int, dst_sr: int):
@@ -122,7 +126,8 @@ def _phase_filters(src_sr: int, dst_sr: int):
     for p, w in enumerate(weights):
         wmat[p, : len(w)] = w
     out = (wmat, np.asarray(lefts), L, M)
-    _PHASE_CACHE[key] = out
+    with _PHASE_LOCK:
+        _PHASE_CACHE[key] = out
     return out
 
 

@@ -25,7 +25,6 @@ from typing import Dict
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from video_features_tpu.extract.base import BaseExtractor

@@ -14,7 +14,6 @@ Output contract: ``{resnetXX: (T, feat_dim), fps, timestamps_ms}``
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List
 
 import numpy as np

@@ -18,8 +18,6 @@ from typing import Dict
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
 
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.audio import load_audio_for_model
