@@ -966,13 +966,14 @@ def _sub_analysis_overhead() -> dict:
     """Wall-time of a full graftcheck sweep (docs/analysis.md): the
     static-analysis suite is meant to run on every push via
     scripts/check.sh, so it carries an explicit latency budget — a full
-    package lint (parse + host-sync + jit-hygiene + thread-safety over
-    every module) must stay under 5 s on one core. The budget is
+    package lint (parse + the whole-program call graph + interprocedural
+    taint + jit-hygiene + thread-reachability + sharding contracts over
+    every module) must stay under 8 s on one core. The budget is
     reported here and pinned in-band so a checker that grows an
     accidentally quadratic pass shows up as a bench regression."""
     from video_features_tpu.analysis import run_checks
 
-    budget_s = 5.0
+    budget_s = 8.0
     t0 = time.perf_counter()
     findings = run_checks()
     cold_s = time.perf_counter() - t0  # includes first-parse of the package

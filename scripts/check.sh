@@ -6,11 +6,16 @@
 #
 #   ./scripts/check.sh            # everything
 #   SKIP_PYTEST=1 ./scripts/check.sh   # lints only (sub-second feedback)
+#   ./scripts/check.sh --diff origin/main   # incremental: findings on
+#                                 # changed lines only (CI's PR mode)
+#
+# Extra args pass straight to the graftcheck CLI (--rule, --diff,
+# --explain, ... — see python -m video_features_tpu.analysis --help).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== graftcheck (python -m video_features_tpu.analysis) =="
-JAX_PLATFORMS=cpu python -m video_features_tpu.analysis
+JAX_PLATFORMS=cpu python -m video_features_tpu.analysis "$@"
 
 echo
 echo "== ruff =="

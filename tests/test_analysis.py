@@ -133,6 +133,102 @@ def test_hostsync_only_runs_on_hot_modules(tmp_path):
     assert fs == []
 
 
+# --- GC10x interprocedural taint (v2) ---------------------------------------
+
+
+def test_hostsync_flags_device_value_returned_through_helper(tmp_path):
+    """THE v2 acceptance fixture: a helper returns a device value that the
+    caller syncs — v1's per-function scan could not see it; the call-graph
+    taint pass must, and must carry the propagation chain."""
+    fs = _check(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def _score(x):
+            return jnp.square(x).mean()
+
+        def hot(x):
+            s = _score(x)
+            return float(s)         # GC102 via _score's return
+        """,
+        prefix=HOT,
+    )
+    assert _ids(fs) == ["GC102"]
+    assert fs[0].line == 10
+    assert fs[0].trace, "interprocedural finding must carry a trace"
+    assert "_score" in " ".join(fs[0].trace)
+
+
+def test_hostsync_taint_flows_through_param_passthrough(tmp_path):
+    """A helper that merely forwards its argument propagates the caller's
+    device taint back out (param-index summaries)."""
+    fs = _check(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def _ident(v):
+            return v
+
+        def hot(x):
+            d = _ident(jnp.ones(3))
+            return d.item()         # GC101 through the pass-through
+        """,
+        prefix=HOT,
+    )
+    assert _ids(fs) == ["GC101"]
+    assert fs[0].trace
+
+
+def test_hostsync_helper_returning_host_value_is_clean(tmp_path):
+    """A helper whose return is a host value (np reduction of python
+    input, .shape metadata) must NOT taint the caller — the precision
+    that makes the interprocedural pass adoptable."""
+    fs = _check(
+        tmp_path,
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def _geometry(x):
+            return x.shape[0] - 1
+
+        def hot(x, vals):
+            y = jnp.square(x)
+            n = _geometry(y)        # metadata: host-side
+            return float(n) + float(np.sum(vals))
+        """,
+        prefix=HOT,
+    )
+    assert fs == []
+
+
+def test_hostsync_retired_broadcast_waiver_would_refire(monkeypatch, tmp_path):
+    """PR-4 waived the multihost broadcast sync in extract/base.py; v2
+    retired the waiver by teaching taint that broadcast_one_to_all
+    returns a HOST value. Pin both directions: the fixture is clean with
+    the fact in place, and re-fires if the fact regresses."""
+    from video_features_tpu.analysis import taint
+
+    src = """
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        def hot(done):
+            return bool(multihost_utils.broadcast_one_to_all(np.int32(done)))
+        """
+    assert _check(tmp_path, src, name="clean.py", prefix=HOT) == []
+    monkeypatch.setattr(
+        taint,
+        "_HOST_RESULTS",
+        taint._HOST_RESULTS
+        - {"jax.experimental.multihost_utils.broadcast_one_to_all"},
+    )
+    fs = _check(tmp_path, src, name="regressed.py", prefix=HOT)
+    assert _ids(fs) == ["GC102"]
+
+
 # --- GC20x jit hygiene ------------------------------------------------------
 
 
@@ -324,6 +420,145 @@ def test_thread_safety_covers_modules_imported_by_roots(tmp_path):
     assert fs[0].path.endswith("helper.py")
 
 
+# --- GC301 v2: call-graph lock resolution + thread reachability -------------
+
+
+def test_thread_reachability_exempts_init_only_setters(tmp_path):
+    """The retired video.py/faults.py waiver shape: a config-set-once
+    setter NOT reachable from the spawn target is exempt by analysis;
+    the write on the worker path still fires — with the entry chain."""
+    fs = _check(
+        tmp_path,
+        """
+        import threading
+
+        _STATE = {}
+
+        def set_mode(v):
+            _STATE["mode"] = v      # init-only: not thread-reachable
+
+        def worker():
+            _STATE["k"] = 1         # GC301: on the thread path
+
+        def start():
+            threading.Thread(target=worker).start()
+        """,
+        prefix=ROOT,
+    )
+    assert _ids(fs) == ["GC301"]
+    assert fs[0].line == 11 and "worker" in fs[0].message
+    assert any("thread entry" in s for s in fs[0].trace)
+
+
+def test_retired_waiver_shape_refires_when_reached_from_thread(tmp_path):
+    """Regression pin for the retired waivers: the SAME setter flagged
+    the moment a thread path can actually reach it."""
+    fs = _check(
+        tmp_path,
+        """
+        import threading
+
+        _STATE = {}
+
+        def set_mode(v):
+            _STATE["mode"] = v      # GC301 again: worker calls it now
+
+        def worker():
+            set_mode("native")
+
+        def start():
+            threading.Thread(target=worker).start()
+        """,
+        prefix=ROOT,
+    )
+    assert _ids(fs) == ["GC301"]
+    assert "set_mode" in fs[0].message
+
+
+def test_decorator_lock_exempts(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+        _STATE = {}
+
+        def synchronized(fn):
+            def inner(*a, **k):
+                with _LOCK:
+                    return fn(*a, **k)
+            return inner
+
+        @synchronized
+        def poke(k, v):
+            _STATE[k] = v
+        """,
+        prefix=ROOT,
+    )
+    assert fs == []
+
+
+def test_contextmanager_lock_helper_exempts(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        import threading
+        from contextlib import contextmanager
+
+        _GUARD = threading.Lock()
+        _STATE = {}
+
+        @contextmanager
+        def transaction():
+            with _GUARD:
+                yield
+
+        def poke(k, v):
+            with transaction():
+                _STATE[k] = v
+        """,
+        prefix=ROOT,
+    )
+    assert fs == []
+
+
+def test_guarded_callers_exempt_until_an_unlocked_site_appears(tmp_path):
+    guarded = """
+        import threading
+
+        _LOCK = threading.Lock()
+        _STATE = {}
+
+        def _poke(k, v):
+            _STATE[k] = v           # every caller holds _LOCK
+
+        def public(k, v):
+            with _LOCK:
+                _poke(k, v)
+
+        def worker():
+            public("a", 1)
+
+        def start():
+            threading.Thread(target=worker).start()
+        """
+    assert _check(tmp_path, guarded, name="guarded.py", prefix=ROOT) == []
+    leaky = guarded + """
+        def sneak(k, v):
+            _poke(k, v)             # unlocked site: the proof collapses
+
+        def worker2():
+            sneak("b", 2)
+
+        def start2():
+            threading.Thread(target=worker2).start()
+        """
+    fs = _check(tmp_path, leaky, name="leaky.py", prefix=ROOT)
+    assert _ids(fs) == ["GC301"]
+    assert "_poke" in fs[0].message
+
+
 # --- GC401 budget arithmetic (the live counter runs in
 # test_device_preprocess.py against a real extraction) ----------------------
 
@@ -345,6 +580,181 @@ def test_budget_unknown_scenario():
 
 def test_budget_within():
     assert check_counts("clip_device_mixed", {"encode_raw": 2}) == []
+
+
+# --- GC50x sharding contracts -----------------------------------------------
+
+MESH_SCOPE = (
+    "import jax\n"
+    "from video_features_tpu.parallel.sharding import is_mesh\n"
+    "from video_features_tpu.ops.preprocess import device_preprocess_frames\n\n\n"
+    "class Fixture:\n"
+    "    mesh_capable = True\n"
+)
+
+
+def test_gc501_flags_unsharded_mesh_possible_jit(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        def build(self, device):
+            @jax.jit
+            def plain(p, x):            # GC501: mesh-possible, no spec
+                return p @ x
+            return plain
+        """,
+        prefix=MESH_SCOPE,
+    )
+    assert _ids(fs) == ["GC501"]
+    assert "plain" in fs[0].message
+
+
+def test_gc501_accepts_contracted_and_guarded_forms(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        from video_features_tpu.parallel.sharding import multihost_out_kwargs
+
+        def build(self, device):
+            fwd = jax.jit(inner, **multihost_out_kwargs(device))  # splat
+
+            @jax.jit
+            def constrained(p, x):
+                x = jax.lax.with_sharding_constraint(x, spec(device))
+                return p @ x
+
+            if is_mesh(device):
+                out = make_sharded(device)
+                return out
+            @jax.jit
+            def solo(p, x):             # after the terminal mesh branch
+                return p @ x
+            return solo
+
+        def build2(self, device):
+            if not is_mesh(device):
+                @jax.jit
+                def queue_only(p, x):   # provably single-device
+                    return p @ x
+                return queue_only
+        """,
+        prefix=MESH_SCOPE,
+    )
+    assert fs == []
+
+
+def test_gc502_fused_entry_needs_both_shardings(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        def build(self, device, batch_sh, rep, out_sh):
+            def encode_raw(p, x_u8, wy, wx):
+                return device_preprocess_frames(x_u8, wy, wx)
+
+            if is_mesh(device):
+                return jax.jit(encode_raw, out_shardings=out_sh)  # GC502
+            return jax.jit(encode_raw)
+        """,
+        prefix=MESH_SCOPE,
+    )
+    assert _ids(fs) == ["GC502"]
+    assert "in_shardings" in fs[0].message
+
+
+def test_gc502_inshardings_tuple_must_cover_every_input(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        def build(self, device, batch_sh, rep, out_sh):
+            def encode_raw(p, x_u8, wy, wx):
+                return device_preprocess_frames(x_u8, wy, wx)
+
+            if is_mesh(device):
+                return jax.jit(
+                    encode_raw,
+                    in_shardings=(None, batch_sh, (rep, rep)),  # 3 of 4
+                    out_shardings=out_sh,
+                )
+            return jax.jit(encode_raw)
+        """,
+        prefix=MESH_SCOPE,
+    )
+    assert _ids(fs) == ["GC502"]
+    assert "3 of 4" in fs[0].message
+
+
+def test_gc503_flags_raw_device_put_under_mesh_polarity(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        def place(self, device, batch):
+            if is_mesh(device):
+                return jax.device_put(batch, device)  # GC503
+            return jax.device_put(batch, device)      # queue: fine
+        """,
+        prefix=MESH_SCOPE,
+    )
+    assert _ids(fs) == ["GC503"]
+
+
+def test_gc50x_ignores_files_outside_mesh_scope(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def plain(p, x):
+            return p @ x
+        """,
+    )
+    assert fs == []
+
+
+def test_dropping_inshardings_from_shipped_fused_entry_fires_gc502(tmp_path):
+    """The acceptance wire: strip the in_shardings spec from the REAL
+    CLIP fused entry and GC502 must fail the sweep — the contract that
+    lets sanity_check admit --sharding mesh --preprocess device."""
+    real = os.path.join(
+        REPO, "video_features_tpu", "models", "clip", "extract_clip.py"
+    )
+    with open(real, encoding="utf-8") as fh:
+        src = fh.read()
+    spec = "in_shardings=(None, batch_sh, (rep, rep), (rep, rep)),"
+    assert spec in src, "the shipped fused entry must pin in_shardings"
+    assert not run_checks([real], rules=["GC502"])
+    stripped = tmp_path / "extract_clip.py"
+    stripped.write_text(src.replace(spec, ""))
+    fs = run_checks([str(stripped)], rules=["GC502"])
+    assert _ids(fs) == ["GC502"]
+    assert "encode_raw" in fs[0].message
+
+
+# --- budget scenarios: the registry and the JSON stay in lockstep -----------
+
+
+def test_budget_scenarios_match_committed_json():
+    """Every committed scenario has a runnable regenerator and tracks
+    exactly the entries its ceiling names (--update-budgets keeps them in
+    sync; this pins that nobody hand-edits one side)."""
+    from video_features_tpu.analysis.budget_scenarios import SCENARIOS
+    from video_features_tpu.analysis.compile_budget import load_budget
+
+    budget = load_budget()
+    assert set(budget) == set(SCENARIOS)
+    for name, sc in SCENARIOS.items():
+        assert set(budget[name]["max_compiles"]) == set(sc.tracked), name
+        assert sc.description == budget[name]["description"], name
+
+
+def test_budget_covers_every_device_preprocess_family():
+    """The GC401 satellite: RAFT/PWC and I3D device scenarios exist
+    alongside CLIP's — the budget follows --preprocess device coverage."""
+    from video_features_tpu.analysis.compile_budget import load_budget
+
+    names = set(load_budget())
+    assert {"clip_device_mixed", "clip_device_grouped", "raft_device_tiny",
+            "pwc_device_tiny", "i3d_device_two_stream"} <= names
 
 
 # --- acceptance: the shipped package is clean, the CLI behaves --------------
@@ -374,7 +784,8 @@ def test_repo_is_clean():
 def test_rule_catalogue_complete():
     ids = [r.id for r in all_rules()]
     assert ids == ["GC101", "GC102", "GC103", "GC104",
-                   "GC201", "GC202", "GC203", "GC301", "GC401"]
+                   "GC201", "GC202", "GC203", "GC301", "GC401",
+                   "GC501", "GC502", "GC503"]
 
 
 def _cli(*args, cwd=REPO):
@@ -415,3 +826,85 @@ def test_cli_list_rules():
     assert r.returncode == 0
     for rid in ("GC101", "GC203", "GC301", "GC401"):
         assert rid in r.stdout
+
+
+def test_cli_rule_accepts_comma_separated_tokens(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        HOT + "import jax.numpy as jnp\n\ndef hot(x):\n"
+        "    y = jnp.square(x)\n    return float(y), y.item()\n"
+    )
+    r = _cli("--json", "--rule", "GC101,GC102", str(bad))
+    assert r.returncode == 1
+    assert sorted(d["rule"] for d in json.loads(r.stdout)) == [
+        "GC101", "GC102"]
+
+
+def test_cli_json_matches_committed_schema(tmp_path):
+    """findings_schema.json is the CI contract for --json: validate a
+    real interprocedural finding against it, trace lines included."""
+    jsonschema = pytest.importorskip("jsonschema")
+    schema_path = os.path.join(
+        REPO, "video_features_tpu", "analysis", "findings_schema.json"
+    )
+    with open(schema_path, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        HOT + "import jax.numpy as jnp\n\n"
+        "def _score(x):\n    return jnp.square(x).mean()\n\n"
+        "def hot(x):\n    return float(_score(x))\n"
+    )
+    r = _cli("--json", str(bad))
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    jsonschema.validate(doc, schema)
+    assert any(d["trace"] for d in doc), "interprocedural trace missing"
+
+
+def test_cli_explain_prints_propagation_chain(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        HOT + "import jax.numpy as jnp\n\n"
+        "def _score(x):\n    return jnp.square(x).mean()\n\n"
+        "def hot(x):\n    return float(_score(x))\n"
+    )
+    r = _cli("--explain", "GC102", str(bad))
+    assert r.returncode == 1
+    assert "via:" in r.stdout and "_score" in r.stdout
+
+
+def test_cli_diff_reports_only_changed_lines(tmp_path):
+    """--diff BASE: a pre-existing violation on an untouched line stays
+    quiet; the violation the diff introduces fails the run."""
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=str(tmp_path), check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        HOT + "import jax.numpy as jnp\n\ndef hot(x):\n"
+        "    return float(jnp.square(x))\n"
+    )
+    git("add", "mod.py")
+    git("commit", "-q", "-m", "seed")
+    r = _cli("--diff", "HEAD", str(mod), cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    mod.write_text(
+        mod.read_text()
+        + "\ndef hotter(x):\n    return jnp.square(x).item()\n"
+    )
+    r = _cli("--diff", "HEAD", str(mod), cwd=str(tmp_path))
+    assert r.returncode == 1
+    assert "GC101" in r.stdout and "GC102" not in r.stdout
+
+
+def test_cli_diff_bad_ref_is_exit_2(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    r = _cli("--diff", "no-such-ref", str(mod))
+    assert r.returncode == 2
+    assert "--diff" in r.stderr

@@ -230,6 +230,70 @@ def test_broken_bucket_sharing_fails_budget(mixed_videos, tmp_path):
     assert violations and "GC401" in violations[0] and "encode_raw" in violations[0]
 
 
+def test_clip_mesh_device_preprocess_parity(mixed_videos, tmp_path):
+    """Acceptance (graftcheck v2 tentpole): --sharding mesh --preprocess
+    device passes sanity_check for CLIP and matches the queue device path
+    on the 2-bucket mixed-resolution corpus — the fused batch axis shards
+    over 'data' with bucket padding applied pre-split (place_raw_payload),
+    under the in/out_shardings contract GC502 enforces statically."""
+    import jax
+
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    mesh_cfg = sanity_check(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="CLIP-ViT-B/32",
+            video_paths=list(mixed_videos),
+            extract_method="uni_4",
+            preprocess="device",
+            sharding="mesh",
+            tmp_path=str(tmp_path / "m" / "tmp"),
+            output_path=str(tmp_path / "m" / "out"),
+            cpu=True,
+        )
+    )
+    mesh = ExtractCLIP(mesh_cfg, external_call=True)(
+        device=make_mesh(jax.devices(), model=1)
+    )
+    queue = _clip_run(mixed_videos, tmp_path / "q", "device")
+    assert len(mesh) == len(queue) == 3
+    for m, q in zip(mesh, queue):
+        np.testing.assert_array_equal(m["timestamps_ms"], q["timestamps_ms"])
+        np.testing.assert_allclose(
+            m["CLIP-ViT-B/32"], q["CLIP-ViT-B/32"], atol=2e-5, rtol=1e-5
+        )
+
+
+def test_mesh_device_preprocess_sanity_gate():
+    """sanity_check admits mesh+device for exactly the feature types whose
+    fused entry carries a GC502-checked sharding contract (CLIP today);
+    everything else still gets the actionable rejection."""
+    from video_features_tpu.config import MESH_DEVICE_PREPROCESS_FEATURE_TYPES
+
+    def cfg(ft, **kw):
+        return ExtractionConfig(
+            allow_random_init=True,
+            feature_type=ft,
+            video_paths=["x.mp4"],
+            sharding="mesh",
+            preprocess="device",
+            cpu=True,
+            **kw,
+        )
+
+    assert "CLIP-ViT-B/32" in MESH_DEVICE_PREPROCESS_FEATURE_TYPES
+    sanity_check(cfg("CLIP-ViT-B/32", extract_method="uni_4"))
+    for ft in ("resnet18", "raft"):
+        with pytest.raises(ValueError, match="GC502"):
+            sanity_check(cfg(ft))
+    with pytest.raises(ValueError, match="mesh_context"):
+        sanity_check(
+            cfg("CLIP-ViT-B/32", extract_method="uni_4", mesh_context=True)
+        )
+
+
 def _resnet_cfg(videos, tmp_path, **kw):
     return ExtractionConfig(
         allow_random_init=True,

@@ -55,8 +55,19 @@ def test_flow_device_matches_host(ft, tiny_flow_videos, tmp_path):
     """No --side_size: the device contract is identity taps + the padder
     placement, so the model sees bit-identical input and the flow matches
     the host path to float noise."""
+    from video_features_tpu.analysis import CompileCounter, assert_within_budget
+
     host = _flow_run(ft, tiny_flow_videos[:1], tmp_path, "host")
-    dev = _flow_run(ft, tiny_flow_videos[:1], tmp_path, "device")
+    # device side runs the FULL tiny corpus: the fused path engages in
+    # the pipelined loop (>1 video), and the 2-clip run is exactly the
+    # {ft}_device_tiny budget scenario (analysis/budget_scenarios.py)
+    with CompileCounter() as cc:
+        dev = _flow_run(ft, tiny_flow_videos, tmp_path, "device")
+    # GC401: one (128, 128) bucket -> one fused executable for the whole
+    # corpus, per the committed ceiling (regenerate: --update-budgets).
+    assert_within_budget(f"{ft}_device_tiny", cc)
+    assert dev[1][ft].shape == (7, 2, 96, 100)
+    assert np.isfinite(dev[1][ft]).all()
     h, d = host[0][ft], dev[0][ft]
     assert h.shape == d.shape == (7, 2, 96, 100)
     np.testing.assert_array_equal(host[0]["timestamps_ms"], dev[0]["timestamps_ms"])
@@ -127,7 +138,13 @@ def test_i3d_device_two_stream_matches_host(sample_video, tmp_path):
         sanity_check(cfg)
         return ExtractI3D(cfg, external_call=True)([0])[0]
 
-    host, dev = run("host"), run("device")
+    from video_features_tpu.analysis import CompileCounter, assert_within_budget
+
+    host = run("host")
+    with CompileCounter() as cc:
+        dev = run("device")
+    # GC401: one stack shape -> one executable per stream.
+    assert_within_budget("i3d_device_two_stream", cc)
     for s in ("rgb", "flow"):
         assert dev[s].shape == host[s].shape == (1, 1024)
         np.testing.assert_allclose(dev[s], host[s], atol=1e-4, rtol=0)

@@ -1,0 +1,243 @@
+"""Runnable GC401 budget scenarios + the ``--update-budgets`` writer.
+
+Each scenario in ``compile_budget.json`` names a real extraction this
+module can reproduce: a deterministic synthetic corpus (utils/synth.py —
+no network, no ffmpeg) driven through the same extractor configuration
+the tests use, traced by :class:`~video_features_tpu.analysis.
+compile_budget.CompileCounter`. ``python -m video_features_tpu.analysis
+--update-budgets [--scenario NAME]`` re-runs the scenarios and rewrites
+the committed ceilings from the measured counts — the ONLY sanctioned
+way to raise a budget, so the diff that raises one carries the
+regenerated number, not a hand edit.
+
+Only the **named jitted entries** of each scenario are budgeted (the
+fused ``encode_raw``/``forward_raw``/``rgb_fn``/``flow_fn`` programs);
+the op-by-op executables JAX builds outside jit (``add``, ``multiply``,
+param-init noise) are deliberately untracked — they scale with model
+depth, not with the bucket-sharing invariant the budget protects.
+
+Import cost: this module imports nothing heavy at module scope; each
+runner imports jax/extractors lazily because ``--update-budgets`` is the
+one analysis mode that executes code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from video_features_tpu.analysis.compile_budget import BUDGET_PATH
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One budgeted extraction: what it runs and which jitted-entry
+    names its ceiling tracks."""
+
+    description: str
+    tracked: Tuple[str, ...]
+    runner: Callable[[str], Dict[str, int]]  # tmp dir -> raw counts
+
+
+def _mixed_videos(tmp: str) -> List[str]:
+    """The tests/test_device_preprocess.py mixed_videos corpus: three
+    resolutions, TWO spatial buckets (426x240 and 420x232 share
+    (256, 448); 320x240 gets its own)."""
+    from video_features_tpu.utils.synth import synth_video
+
+    return [
+        synth_video(os.path.join(tmp, "a.mp4"), n_frames=24, width=426,
+                    height=240, seed=0),
+        synth_video(os.path.join(tmp, "b.mp4"), n_frames=32, width=420,
+                    height=232, seed=1),
+        synth_video(os.path.join(tmp, "c.mp4"), n_frames=28, width=320,
+                    height=240, seed=2),
+    ]
+
+
+def _tiny_flow_videos(tmp: str) -> List[str]:
+    """The e2e tiny flow corpus: both land on RAFT's (128, 128) padder
+    grid, so the fused entry compiles ONCE for the pair."""
+    from video_features_tpu.utils.synth import synth_video
+
+    return [
+        synth_video(os.path.join(tmp, "f1.mp4"), n_frames=8, width=100,
+                    height=96, seed=3),
+        synth_video(os.path.join(tmp, "f2.mp4"), n_frames=8, width=100,
+                    height=96, seed=4),
+    ]
+
+
+def _counted(run: Callable[[], object]) -> Dict[str, int]:
+    from video_features_tpu.analysis.compile_budget import CompileCounter
+
+    with CompileCounter() as cc:
+        run()
+    return dict(cc.counts)
+
+
+def _clip_run(tmp: str, video_batch: int) -> Dict[str, int]:
+    from video_features_tpu.config import ExtractionConfig, sanity_check
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    cfg = sanity_check(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="CLIP-ViT-B/32",
+            extract_method="uni_4",
+            preprocess="device",
+            video_batch=video_batch,
+            video_paths=_mixed_videos(tmp),
+            tmp_path=os.path.join(tmp, "tmp"),
+            output_path=os.path.join(tmp, "out"),
+            cpu=True,
+        )
+    )
+    return _counted(lambda: ExtractCLIP(cfg, external_call=True)())
+
+
+def _flow_run(tmp: str, ft: str) -> Dict[str, int]:
+    from video_features_tpu.config import ExtractionConfig, sanity_check
+
+    if ft == "raft":
+        from video_features_tpu.models.raft.extract_raft import (
+            ExtractRAFT as cls,
+        )
+    else:
+        from video_features_tpu.models.pwc.extract_pwc import (
+            ExtractPWC as cls,
+        )
+    cfg = sanity_check(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type=ft,
+            video_paths=_tiny_flow_videos(tmp),
+            batch_size=4,
+            preprocess="device",
+            tmp_path=os.path.join(tmp, "tmp"),
+            output_path=os.path.join(tmp, "out"),
+            cpu=True,
+        )
+    )
+    return _counted(lambda: cls(cfg, external_call=True)())
+
+
+def _i3d_run(tmp: str) -> Dict[str, int]:
+    from video_features_tpu.config import ExtractionConfig, sanity_check
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+    from video_features_tpu.utils.synth import synth_video
+
+    video = synth_video(os.path.join(tmp, "synth.mp4"))  # 60f 320x240
+    cfg = sanity_check(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type="i3d",
+            video_paths=[video],
+            flow_type="pwc",
+            extraction_fps=5.0,
+            stack_size=10,
+            step_size=10,
+            preprocess="device",
+            tmp_path=os.path.join(tmp, "tmp"),
+            output_path=os.path.join(tmp, "out"),
+            cpu=True,
+        )
+    )
+    return _counted(lambda: ExtractI3D(cfg, external_call=True)([0]))
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    "clip_device_mixed": Scenario(
+        description=(
+            "ExtractCLIP --preprocess device over the mixed_videos fixture "
+            "(tests/test_device_preprocess.py): 3 videos, 2 spatial buckets "
+            "(426x240 and 420x232 share (256,448); 320x240 gets its own), "
+            "video_batch=1."
+        ),
+        tracked=("encode_raw",),
+        runner=lambda tmp: _clip_run(tmp, video_batch=1),
+    ),
+    "clip_device_grouped": Scenario(
+        description=(
+            "Same fixture with video_batch=2: the shared-bucket pair "
+            "dispatches as one group, the odd video solo - grouped and solo "
+            "input layouts are one executable each."
+        ),
+        tracked=("encode_raw",),
+        runner=lambda tmp: _clip_run(tmp, video_batch=2),
+    ),
+    "raft_device_tiny": Scenario(
+        description=(
+            "ExtractRAFT --preprocess device over two 100x96 8-frame clips "
+            "(tests/test_device_preprocess_e2e.py tiny_flow_videos): both "
+            "land on the (128,128) padder grid, so the fused forward_raw "
+            "compiles once for the whole corpus."
+        ),
+        tracked=("forward_raw",),
+        runner=lambda tmp: _flow_run(tmp, "raft"),
+    ),
+    "pwc_device_tiny": Scenario(
+        description=(
+            "ExtractPWC --preprocess device over the same tiny corpus: one "
+            "(128,128) fused forward_raw executable; PWC's pyramid adds no "
+            "per-video shapes."
+        ),
+        tracked=("forward_raw",),
+        runner=lambda tmp: _flow_run(tmp, "pwc"),
+    ),
+    "i3d_device_two_stream": Scenario(
+        description=(
+            "Two-stream ExtractI3D --preprocess device (flow_type=pwc, "
+            "extraction_fps=5, stack 10/10) on the 320x240 synth clip: one "
+            "rgb_fn and one flow_fn executable for the single stack shape."
+        ),
+        tracked=("rgb_fn", "flow_fn"),
+        runner=lambda tmp: _i3d_run(tmp),
+    ),
+}
+
+
+def measure(name: str) -> Dict[str, int]:
+    """Run one scenario in a throwaway dir; return {tracked name: count}.
+    A tracked entry the run never compiled reports 0 (check_counts treats
+    that as a dead budget, which is the point — the scenario must really
+    exercise the entry it budgets)."""
+    sc = SCENARIOS[name]
+    with tempfile.TemporaryDirectory(prefix=f"graftcheck_{name}_") as tmp:
+        raw = sc.runner(tmp)
+    return {entry: int(raw.get(entry, 0)) for entry in sc.tracked}
+
+
+def update_budgets(names: Optional[Sequence[str]] = None) -> int:
+    """Re-measure ``names`` (default: every scenario) and rewrite
+    ``compile_budget.json`` with the observed counts as the new ceilings.
+    Returns a process exit code (0 ok, 2 on unknown scenario)."""
+    chosen = list(names) if names else sorted(SCENARIOS)
+    unknown = [n for n in chosen if n not in SCENARIOS]
+    if unknown:
+        print(
+            f"graftcheck: unknown scenario(s): {', '.join(unknown)} "
+            f"(have: {', '.join(sorted(SCENARIOS))})",
+            file=sys.stderr,
+        )
+        return 2
+    with open(BUDGET_PATH, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    doc.setdefault("scenarios", {})
+    for name in chosen:
+        counts = measure(name)
+        doc["scenarios"][name] = {
+            "description": SCENARIOS[name].description,
+            "max_compiles": counts,
+        }
+        pretty = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        print(f"graftcheck: {name}: {pretty}")
+    with open(BUDGET_PATH, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+    print(f"graftcheck: wrote {BUDGET_PATH}")
+    return 0

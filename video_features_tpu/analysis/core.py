@@ -54,12 +54,22 @@ class Finding:
     rule: Rule
     message: str
     hint: str = ""
+    # interprocedural provenance: "path:line: description" steps from the
+    # origin (device creation, lock-free entry) to this finding's line.
+    # ``--explain`` prints it; ``--json`` always carries it (may be []).
+    trace: List[str] = dataclasses.field(default_factory=list)
 
     def format(self) -> str:
         s = f"{self.path}:{self.line}:{self.col}: {self.rule.id} {self.rule.name}: {self.message}"
         if self.hint:
             s += f"\n    fix: {self.hint}"
         return s
+
+    def format_trace(self) -> str:
+        lines = [self.format()]
+        for step in self.trace:
+            lines.append(f"    via: {step}")
+        return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, object]:
         return {
@@ -70,6 +80,7 @@ class Finding:
             "name": self.rule.name,
             "message": self.message,
             "hint": self.hint,
+            "trace": list(self.trace),
         }
 
 
@@ -349,15 +360,27 @@ def run_checks(
     """Run every static checker over ``paths`` (default: the installed
     package), drop waived findings, return the rest sorted by location.
     ``rules`` filters to findings whose rule id/name matches any token."""
-    from video_features_tpu.analysis import hostsync, jit_hygiene, thread_safety
+    from video_features_tpu.analysis import (
+        hostsync,
+        jit_hygiene,
+        sharding_contract,
+        thread_safety,
+    )
+    from video_features_tpu.analysis.callgraph import CallGraph
+    from video_features_tpu.analysis.taint import ProjectTaint
 
     sources = collect_sources(paths)
+    # one call graph + taint context per sweep, shared by the
+    # interprocedural passes (GC10x, GC301, GC50x)
+    graph = CallGraph(sources)
+    project = ProjectTaint(sources, graph)
     findings: List[Finding] = []
     for src in sources:
         if src.is_hot:
-            findings.extend(hostsync.check(src))
+            findings.extend(hostsync.check(src, project))
         findings.extend(jit_hygiene.check(src))
-    findings.extend(thread_safety.check(sources))
+    findings.extend(thread_safety.check(sources, graph))
+    findings.extend(sharding_contract.check(sources, graph))
 
     kept = []
     for f in findings:
@@ -372,7 +395,12 @@ def run_checks(
 
 
 def all_rules() -> List[Rule]:
-    from video_features_tpu.analysis import hostsync, jit_hygiene, thread_safety
+    from video_features_tpu.analysis import (
+        hostsync,
+        jit_hygiene,
+        sharding_contract,
+        thread_safety,
+    )
     from video_features_tpu.analysis.compile_budget import BUDGET_RULE
 
     return [
@@ -380,4 +408,5 @@ def all_rules() -> List[Rule]:
         *jit_hygiene.RULES.values(),
         thread_safety.RULE,
         BUDGET_RULE,
+        *sharding_contract.RULES.values(),
     ]

@@ -9,33 +9,36 @@ hot modules (``extract/``, ``ops/``, ``models/*/model.py``) inserts a
 hidden synchronous round-trip per call site — invisible in review,
 catastrophic over a million-video corpus.
 
-Device-value tracking is a deliberately shallow intra-function taint
-pass: a name is "device-tainted" when it is a parameter of a jitted
-function or was assigned from a ``jax.*``/``jnp.*``/``lax.*`` call (or
-an expression over tainted names). ``int(math.ceil(...))`` on host
-geometry never taints; ``int(jnp.argmax(x))`` does. Unambiguous sync
+v2: device-value tracking is the *interprocedural* taint engine in
+``taint.py`` — a name is device-tainted when it is a parameter of a
+jitted function, was assigned from a ``jax.*``/``jnp.*``/``lax.*`` call
+(or an expression over tainted names), **or flowed here through a
+project call** (a helper's device return, a device argument a caller
+passed in). ``int(math.ceil(...))`` on host geometry never taints;
+``int(jnp.argmax(x))`` does; so does ``int(helper(x))`` when the helper
+returns its jnp result. Every finding carries the propagation chain in
+``Finding.trace`` (``--explain GC10x`` prints it). Unambiguous sync
 idioms (``.item()``, ``.block_until_ready()``) are flagged regardless of
 taint.
 
 The sink/fetch boundary is allowlisted by function name: ``fetch_*`` and
 ``*sink*`` functions exist to sync (that is the contract — the pipelined
 loop calls them exactly once per video, after the next video's dispatch
-is already in flight).
+is already in flight). The allowlist covers defs nested inside them too.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set
+from typing import List, Optional
 
-from video_features_tpu.analysis.core import (
-    Finding,
-    Rule,
-    SourceFile,
-    import_aliases,
-    jit_decoration,
-    param_names,
-    resolve_dotted,
+from video_features_tpu.analysis.core import Finding, Rule, SourceFile
+from video_features_tpu.analysis.taint import (
+    _FETCHERS,
+    ProjectTaint,
+    Taint,
+    flatten_body,
+    format_chain,
 )
 
 RULES = {
@@ -58,23 +61,6 @@ RULES = {
 ALLOWED_NAME_PREFIXES = ("fetch_", "_fetch")
 ALLOWED_NAME_SUBSTRINGS = ("sink",)
 
-# heads whose call results live on device
-_DEVICE_HEADS = ("jax", "jnp", "jax.numpy", "lax", "jax.lax", "flax")
-# jax calls whose results are HOST values (never taint)
-_HOST_RESULTS = frozenset(
-    {
-        "jax.device_get",
-        "jax.process_index",
-        "jax.process_count",
-        "jax.device_count",
-        "jax.local_device_count",
-        "jax.devices",
-        "jax.local_devices",
-        "jax.default_backend",
-    }
-)
-_FETCHERS = frozenset({"numpy.asarray", "numpy.array", "jax.device_get"})
-
 
 def _allowlisted(name: str) -> bool:
     return name.startswith(ALLOWED_NAME_PREFIXES) or any(
@@ -82,83 +68,20 @@ def _allowlisted(name: str) -> bool:
     )
 
 
-def check(src: SourceFile) -> List[Finding]:
-    aliases = import_aliases(src.tree)
+def check(src: SourceFile, project: ProjectTaint) -> List[Finding]:
+    from video_features_tpu.analysis.core import resolve_dotted
+
+    aliases = project._aliases[src.rel]
     findings: List[Finding] = []
 
-    def scan_scope(body: List[ast.stmt], tainted: Set[str], fn_name: str) -> None:
-        """One function (or module) scope: fixpoint-taint its locals,
-        then flag sync idioms. Nested defs get their own scope (jitted
-        nested defs start with their params tainted)."""
-        if _allowlisted(fn_name):
-            return
+    def trace_of(t: Taint, tail: str, line: int) -> List[str]:
+        if not t.device or not t.chain:
+            return []
+        return format_chain(t.chain) + [f"{src.path}:{line}: {tail}"]
 
-        nested: List[ast.FunctionDef] = []
-        flat: List[ast.stmt] = []
-
-        def flatten(stmts):
-            for st in stmts:
-                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    nested.append(st)
-                    continue
-                flat.append(st)
-                for field in ("body", "orelse", "finalbody"):
-                    flatten(getattr(st, field, []) or [])
-                for h in getattr(st, "handlers", []) or []:
-                    flatten(h.body)
-                for case in getattr(st, "cases", []) or []:
-                    flatten(case.body)
-
-        flatten(body)
-
-        # taint fixpoint over the flattened statement list
-        for _ in range(4):
-            changed = False
-            for st in flat:
-                if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
-                    value = st.value
-                    if value is None or not _tainted_expr(value, tainted, aliases):
-                        continue
-                    targets = (
-                        st.targets
-                        if isinstance(st, ast.Assign)
-                        else [st.target]
-                    )
-                    for t in targets:
-                        for n in _target_names(t):
-                            if n not in tainted:
-                                tainted.add(n)
-                                changed = True
-            if not changed:
-                break
-
-        # flag pass: walk each flattened statement's EXPRESSION children
-        # only (child statements are in ``flat`` themselves; nested defs
-        # get their own scope) so no call site is visited twice
-        for st in flat:
-            for child in ast.iter_child_nodes(st):
-                if isinstance(
-                    child,
-                    (ast.stmt, ast.excepthandler, ast.FunctionDef,
-                     ast.AsyncFunctionDef),
-                ) or type(child).__name__ == "match_case":
-                    continue
-                for node in ast.walk(child):
-                    if isinstance(node, ast.Call):
-                        _flag_call(node, tainted, fn_name)
-
-        for sub in nested:
-            sub_tainted = set(tainted)
-            site = jit_decoration(sub, aliases)
-            if site is not None:
-                static = set(site.static_argnames)
-                sub_tainted |= {
-                    p for p in param_names(sub) if p not in static
-                }
-            scan_scope(sub.body, sub_tainted, sub.name)
-
-    def _flag_call(node: ast.Call, tainted: Set[str], fn_name: str) -> None:
+    def flag_call(node: ast.Call, env, info, fn_name: str) -> None:
         func = node.func
+        taint = lambda e: project.expr_taint(e, env, src, info)  # noqa: E731
         if isinstance(func, ast.Attribute):
             if func.attr == "item" and not node.args:
                 findings.append(
@@ -167,6 +90,9 @@ def check(src: SourceFile) -> List[Finding]:
                         f".item() in hot function {fn_name!r}",
                         "keep the value on device (jnp.where/compare), or move "
                         "the sync to the fetch boundary",
+                        trace=trace_of(
+                            taint(func.value), ".item() syncs here", node.lineno
+                        ),
                     )
                 )
                 return
@@ -177,68 +103,73 @@ def check(src: SourceFile) -> List[Finding]:
                         f"block_until_ready() in hot function {fn_name!r}",
                         "only the sink/fetch boundary may block; delete the "
                         "barrier or move it into fetch_*",
+                        trace=trace_of(
+                            taint(func.value),
+                            "block_until_ready() blocks here",
+                            node.lineno,
+                        ),
                     )
                 )
                 return
         rd = resolve_dotted(func, aliases)
         if rd in ("float", "int", "bool", "complex") and node.args:
-            if _tainted_expr(node.args[0], tainted, aliases):
+            t = taint(node.args[0])
+            if t.device:
                 findings.append(
                     Finding(
                         src.path, node.lineno, node.col_offset, RULES["GC102"],
                         f"{rd}() on a traced/device value in {fn_name!r}",
                         "keep the scalar on device (jnp ops) or fetch it once "
                         "at the sink boundary",
+                        trace=trace_of(t, f"{rd}() syncs here", node.lineno),
                     )
                 )
             return
         if rd in _FETCHERS:
-            if rd == "jax.device_get" or (
-                node.args and _tainted_expr(node.args[0], tainted, aliases)
-            ):
+            t = taint(node.args[0]) if node.args else Taint()
+            if rd == "jax.device_get" or t.device:
                 findings.append(
                     Finding(
                         src.path, node.lineno, node.col_offset, RULES["GC103"],
                         f"{rd}() on a device value in {fn_name!r}",
                         "return the device array and let fetch_*/the sink "
                         "materialize it",
+                        trace=trace_of(t, f"{rd}() syncs here", node.lineno),
                     )
                 )
 
-    scan_scope(src.tree.body, set(), "<module>")
+    def flag_scope(body, env, info, fn_name: str) -> None:
+        """Walk each flattened statement's EXPRESSION children only
+        (child statements are in the flat list themselves; nested defs
+        get their own scope) so no call site is visited twice."""
+        for st in flatten_body(body):
+            for child in ast.iter_child_nodes(st):
+                if isinstance(
+                    child,
+                    (ast.stmt, ast.excepthandler, ast.FunctionDef,
+                     ast.AsyncFunctionDef),
+                ) or type(child).__name__ == "match_case":
+                    continue
+                for node in ast.walk(child):
+                    if isinstance(node, ast.Call):
+                        flag_call(node, env, info, fn_name)
+
+    flag_scope(src.tree.body, project.module_env(src), None, "<module>")
+
+    for key, info in project.graph.functions.items():
+        if info.src is not src:
+            continue
+        if _scope_allowlisted(project, info):
+            continue
+        flag_scope(info.node.body, project.env_for(key), info, info.name)
+
     return findings
 
 
-def _target_names(t: ast.AST) -> List[str]:
-    if isinstance(t, ast.Name):
-        return [t.id]
-    if isinstance(t, (ast.Tuple, ast.List)):
-        out: List[str] = []
-        for el in t.elts:
-            out.extend(_target_names(el))
-        return out
-    if isinstance(t, ast.Starred):
-        return _target_names(t.value)
-    return []
-
-
-def _tainted_expr(node: ast.AST, tainted: Set[str], aliases: Dict[str, str]) -> bool:
-    """Does evaluating ``node`` touch a device value?"""
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Name) and sub.id in tainted:
+def _scope_allowlisted(project: ProjectTaint, info) -> bool:
+    cur: Optional[object] = info
+    while cur is not None:
+        if _allowlisted(cur.name):
             return True
-        if isinstance(sub, ast.Call):
-            rd = resolve_dotted(sub.func, aliases)
-            if rd is None:
-                continue
-            if rd in _HOST_RESULTS:
-                continue
-            head = rd.split(".")[0]
-            resolved_head = aliases.get(head, head)
-            if resolved_head in ("jax", "lax", "flax") or rd.startswith(
-                ("jax.numpy.", "jax.lax.", "jax.nn.")
-            ):
-                return True
-            if resolved_head == "jax.numpy" or resolved_head == "jax.lax":
-                return True
+        cur = project.graph.functions.get(cur.parent) if cur.parent else None
     return False

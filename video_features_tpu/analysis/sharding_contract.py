@@ -1,0 +1,401 @@
+"""GC50x — sharding-spec contracts for mesh-reachable jit entries.
+
+``--sharding mesh`` turns every jit dispatch into a collective: an entry
+that does not say where its inputs and outputs live either silently
+replicates the whole batch onto every device (memory x8, bandwidth x8)
+or deadlocks a multi-host run when processes disagree on layout. The
+paper's throughput argument needs the *fused preprocess* entries — which
+take the raw frame batch plus the banded resample taps — to shard the
+frame-batch axis over ``'data'`` and replicate the taps; docs/tpu.md
+documents the contract, this family enforces it statically.
+
+Scope: modules that declare a ``mesh_capable = True`` extractor plus
+everything under ``parallel/``. Within scope, every jit application
+(``@jax.jit`` / ``@partial(jax.jit, ...)`` decorations and
+``name = jax.jit(fn, ...)`` wrap-calls) is classified by *mesh polarity*
+— a lexical reachability fact derived from ``is_mesh(...)`` guards:
+
+- inside ``if is_mesh(device):`` the polarity is mesh-True;
+- inside ``else:`` / under ``not is_mesh(...)`` (including name-bound
+  conditions like ``dev_pre = enabled and not is_mesh(device)``) it is
+  mesh-False — such sites are single-device by construction and exempt;
+- after a *terminal* ``if is_mesh(...): ... return`` branch the rest of
+  the suite is mesh-False (the factory early-return pattern);
+- anything else is mesh-possible and must carry a contract.
+
+Rules:
+
+- **GC501 mesh-jit-unsharded** — a mesh-possible jit entry declares no
+  sharding at all: no ``in_shardings``/``out_shardings`` at the site, no
+  ``**multihost_out_kwargs(...)`` splat, and no
+  ``with_sharding_constraint``/``shard_map`` inside the jitted body
+  (directly or via a one-level local helper).
+- **GC502 mesh-fused-shardings** — a mesh-possible jit entry whose body
+  runs the fused preprocess (``device_preprocess_frames`` /
+  ``device_resize_frames``) must pin BOTH ``in_shardings`` and
+  ``out_shardings`` explicitly, and a tuple-literal ``in_shardings``
+  must cover every positional parameter (dropping one spec silently
+  replicates that input).
+- **GC503 mesh-transfer-unsharded** — under mesh-True polarity, raw
+  ``jax.device_put`` belongs to the ``parallel.sharding`` placement
+  helpers (``place_batch``/``place_params``/``place_raw_payload``),
+  which attach NamedShardings; a direct call in an extractor places the
+  whole batch on one device.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from video_features_tpu.analysis.callgraph import CallGraph
+from video_features_tpu.analysis.core import (
+    Finding,
+    Rule,
+    SourceFile,
+    import_aliases,
+    is_jax_jit,
+    param_names,
+    resolve_dotted,
+)
+
+RULES = {
+    "GC501": Rule(
+        "GC501", "mesh-jit-unsharded",
+        "a jit entry reachable under --sharding mesh declares no sharding spec",
+    ),
+    "GC502": Rule(
+        "GC502", "mesh-fused-shardings",
+        "a mesh-reachable fused-preprocess jit entry must pin in_shardings "
+        "and out_shardings for the frame batch and resample taps",
+    ),
+    "GC503": Rule(
+        "GC503", "mesh-transfer-unsharded",
+        "raw jax.device_put under mesh polarity bypasses the sharded "
+        "placement helpers",
+    ),
+}
+
+_FUSED_ENTRIES = ("device_preprocess_frames", "device_resize_frames")
+_BODY_CONSTRAINTS = ("with_sharding_constraint", "shard_map")
+_SHARDING_SPLATS = ("multihost_out_kwargs",)
+
+
+@dataclasses.dataclass
+class _JitApp:
+    """One jit application in scope: the site, its mesh polarity, the
+    jitted def when resolvable, and the keywords at the site."""
+
+    line: int
+    col: int
+    name: str  # display name of the jitted entry
+    polarity: int  # +1 mesh, -1 not-mesh, 0 unknown
+    fn: Optional[ast.FunctionDef]
+    keywords: List[ast.keyword]
+
+
+def check(sources: Sequence[SourceFile], graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in sources:
+        if not _in_scope(src):
+            continue
+        findings.extend(_check_file(src))
+    return findings
+
+
+def _in_scope(src: SourceFile) -> bool:
+    if src.rel.startswith("parallel/"):
+        return True
+    for node in src.tree.body:
+        if isinstance(node, ast.ClassDef):
+            for st in node.body:
+                targets = []
+                if isinstance(st, ast.Assign):
+                    targets = st.targets
+                elif isinstance(st, ast.AnnAssign):
+                    targets = [st.target]
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Name)
+                        and t.id == "mesh_capable"
+                        and isinstance(getattr(st, "value", None), ast.Constant)
+                        and st.value.value is True
+                    ):
+                        return True
+    return False
+
+
+def _check_file(src: SourceFile) -> List[Finding]:
+    aliases = import_aliases(src.tree)
+    findings: List[Finding] = []
+    apps: List[_JitApp] = []
+    puts: List[tuple] = []  # (call, polarity)
+
+    def polarity_of(test: ast.AST, local_pol: Dict[str, int]) -> int:
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return -polarity_of(test.operand, local_pol)
+        if isinstance(test, ast.Call):
+            rd = resolve_dotted(test.func, aliases)
+            if rd is not None and rd.split(".")[-1] == "is_mesh":
+                return 1
+            return 0
+        if isinstance(test, ast.Name):
+            return local_pol.get(test.id, 0)
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            # the branch being taken implies every conjunct held
+            for v in test.values:
+                p = polarity_of(v, local_pol)
+                if p:
+                    return p
+        return 0
+
+    def combine(outer: int, inner: int) -> int:
+        return inner if inner else outer
+
+    def terminates(body: List[ast.stmt]) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+        )
+
+    def record_decorated(fn: ast.FunctionDef, ctx: int) -> None:
+        for dec in fn.decorator_list:
+            if is_jax_jit(dec, aliases):
+                apps.append(_JitApp(fn.lineno, fn.col_offset, fn.name, ctx, fn, []))
+                return
+            if isinstance(dec, ast.Call):
+                callee = resolve_dotted(dec.func, aliases)
+                if callee in ("functools.partial", "partial") and dec.args:
+                    if is_jax_jit(dec.args[0], aliases):
+                        apps.append(
+                            _JitApp(fn.lineno, fn.col_offset, fn.name, ctx,
+                                    fn, list(dec.keywords))
+                        )
+                        return
+                elif is_jax_jit(dec.func, aliases):
+                    apps.append(
+                        _JitApp(fn.lineno, fn.col_offset, fn.name, ctx,
+                                fn, list(dec.keywords))
+                    )
+                    return
+
+    def scan_expr(node: ast.AST, ctx: int, defs: Dict[str, ast.FunctionDef],
+                  display: str) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if is_jax_jit(sub.func, aliases):
+                fn = None
+                name = display
+                if sub.args and isinstance(sub.args[0], ast.Name):
+                    fn = defs.get(sub.args[0].id)
+                    name = sub.args[0].id
+                apps.append(
+                    _JitApp(sub.lineno, sub.col_offset, name, ctx, fn,
+                            list(sub.keywords))
+                )
+            else:
+                rd = resolve_dotted(sub.func, aliases)
+                if rd is not None and rd.split(".")[-1] == "device_put":
+                    puts.append((sub, ctx))
+
+    def visit_suite(stmts: List[ast.stmt], ctx: int,
+                    local_pol: Dict[str, int],
+                    defs: Dict[str, ast.FunctionDef]) -> None:
+        cur = ctx
+        for st in stmts:
+            if isinstance(st, ast.If):
+                p = polarity_of(st.test, local_pol)
+                scan_expr(st.test, cur, defs, "<test>")
+                visit_suite(st.body, combine(cur, p), dict(local_pol), defs)
+                visit_suite(st.orelse, combine(cur, -p if p else 0),
+                            dict(local_pol), defs)
+                if p and terminates(st.body) and not st.orelse:
+                    # factory early-return: the rest of this suite only
+                    # runs when the test was false
+                    cur = combine(cur, -p)
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[st.name] = st
+                record_decorated(st, cur)
+                visit_suite(st.body, cur, dict(local_pol), dict(defs))
+                continue
+            if isinstance(st, ast.ClassDef):
+                visit_suite(st.body, cur, dict(local_pol), dict(defs))
+                continue
+            display = "<expr>"
+            if isinstance(st, ast.Assign):
+                if len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+                    tname = st.targets[0].id
+                    display = tname
+                    p = polarity_of(st.value, local_pol)
+                    local_pol[tname] = p
+                elif len(st.targets) == 1 and isinstance(
+                    st.targets[0], ast.Subscript
+                ):
+                    display = "<subscript>"
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if sub:
+                    visit_suite(sub, cur, local_pol, defs)
+            for h in getattr(st, "handlers", []) or []:
+                visit_suite(h.body, cur, local_pol, defs)
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, (ast.stmt, ast.excepthandler)):
+                    continue
+                scan_expr(child, cur, defs, display)
+
+    visit_suite(src.tree.body, 0, {}, {})
+
+    splat_names = _sharding_splat_names(src.tree, aliases)
+
+    for app in apps:
+        if app.polarity < 0:
+            continue  # provably single-device
+        fused = app.fn is not None and _calls_fused(app.fn, aliases)
+        kwnames = {kw.arg for kw in app.keywords if kw.arg}
+        if fused:
+            missing = [
+                k for k in ("in_shardings", "out_shardings") if k not in kwnames
+            ]
+            if missing:
+                findings.append(
+                    Finding(
+                        src.path, app.line, app.col, RULES["GC502"],
+                        f"fused-preprocess jit entry {app.name!r} is mesh-"
+                        f"reachable but does not pin {', '.join(missing)}",
+                        "declare in_shardings=(None, NamedSharding(mesh, "
+                        "P('data')), rep, rep) and out_shardings for the "
+                        "fused entry, or guard the build with `not "
+                        "is_mesh(device)`",
+                    )
+                )
+                continue
+            bad = _inshardings_arity_gap(app)
+            if bad is not None:
+                findings.append(
+                    Finding(
+                        src.path, app.line, app.col, RULES["GC502"],
+                        f"in_shardings on fused entry {app.name!r} covers "
+                        f"{bad[0]} of {bad[1]} positional inputs — a dropped "
+                        f"spec replicates that input onto every device",
+                        "give every positional input an explicit spec (None "
+                        "inherits from the placed argument)",
+                    )
+                )
+            continue
+        if kwnames & {"in_shardings", "out_shardings"}:
+            continue
+        if _has_sharding_splat(app, splat_names, aliases):
+            continue
+        if app.fn is not None and _body_constrained(app.fn, aliases):
+            continue
+        findings.append(
+            Finding(
+                src.path, app.line, app.col, RULES["GC501"],
+                f"jit entry {app.name!r} is reachable under --sharding mesh "
+                f"but declares no sharding spec",
+                "add in_shardings/out_shardings (or **multihost_out_kwargs), "
+                "constrain inside the body with with_sharding_constraint/"
+                "shard_map, or guard the build with `not is_mesh(device)`",
+            )
+        )
+
+    if not src.rel.startswith("parallel/"):
+        for call, ctx in puts:
+            if ctx > 0:
+                findings.append(
+                    Finding(
+                        src.path, call.lineno, call.col_offset, RULES["GC503"],
+                        "raw jax.device_put under mesh polarity places the "
+                        "whole batch on one device",
+                        "route placement through parallel.sharding "
+                        "(place_batch/place_params/place_raw_payload) so the "
+                        "batch axis lands sharded over 'data'",
+                    )
+                )
+    return findings
+
+
+def _sharding_splat_names(tree: ast.AST, aliases: Dict[str, str]) -> set:
+    """Local names bound (anywhere) to ``multihost_out_kwargs(...)`` —
+    ``mh = multihost_out_kwargs(dev); jax.jit(fn, **mh)`` carries the
+    contract through the name."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            rd = resolve_dotted(node.value.func, aliases)
+            if rd is not None and rd.split(".")[-1] in _SHARDING_SPLATS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _has_sharding_splat(app: _JitApp, splat_names: set,
+                        aliases: Dict[str, str]) -> bool:
+    for kw in app.keywords:
+        if kw.arg is not None:
+            continue
+        if isinstance(kw.value, ast.Name) and kw.value.id in splat_names:
+            return True
+        if isinstance(kw.value, ast.Call):
+            rd = resolve_dotted(kw.value.func, aliases)
+            if rd is not None and rd.split(".")[-1] in _SHARDING_SPLATS:
+                return True
+    return False
+
+
+def _local_defs(fn: ast.FunctionDef) -> Dict[str, ast.FunctionDef]:
+    return {
+        n.name: n
+        for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+    }
+
+
+def _calls_in(fn: ast.FunctionDef, aliases: Dict[str, str],
+              targets: Sequence[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            rd = resolve_dotted(node.func, aliases)
+            if rd is not None and rd.split(".")[-1] in targets:
+                return True
+    return False
+
+
+def _calls_fused(fn: ast.FunctionDef, aliases: Dict[str, str]) -> bool:
+    return _calls_in(fn, aliases, _FUSED_ENTRIES)
+
+
+def _body_constrained(fn: ast.FunctionDef, aliases: Dict[str, str]) -> bool:
+    """with_sharding_constraint/shard_map in the jitted body, directly or
+    through a one-level local helper call (the i3d ``shard_seq`` idiom)."""
+    if _calls_in(fn, aliases, _BODY_CONSTRAINTS):
+        return True
+    # one level: names this body calls that are local defs of the body's
+    # own enclosing scope are out of view here, so resolve bare-name calls
+    # against the defs nested in fn itself
+    local = _local_defs(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            helper = local.get(node.func.id)
+            if helper is not None and _calls_in(helper, aliases, _BODY_CONSTRAINTS):
+                return True
+    return False
+
+
+def _inshardings_arity_gap(app: _JitApp):
+    """(given, expected) when a tuple-literal in_shardings does not cover
+    every positional parameter of the jitted def; None when fine."""
+    if app.fn is None:
+        return None
+    for kw in app.keywords:
+        if kw.arg == "in_shardings" and isinstance(kw.value, (ast.Tuple, ast.List)):
+            expected = len(param_names(app.fn)) - (
+                1 if app.fn.args.vararg else 0
+            ) - (1 if app.fn.args.kwarg else 0)
+            given = len(kw.value.elts)
+            if given != expected:
+                return (given, expected)
+    return None

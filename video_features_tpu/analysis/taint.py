@@ -1,0 +1,454 @@
+"""Interprocedural device-taint for the GC10x host-sync lint (v2).
+
+v1's taint was intra-function: a device array returned through a helper
+and ``.item()``'d in the caller was invisible (ROADMAP residual). v2
+computes per-function *taint summaries* over the project call graph and
+propagates device-ness in both directions:
+
+- **returns**: a helper whose return value is device-tainted taints the
+  call expression in every caller (``h = helper(x); float(h)`` flags in
+  the caller);
+- **parameters**: a device value passed into a helper taints the matching
+  parameter inside the helper, and a helper that returns one of its
+  parameters propagates the argument's taint back to the call site.
+
+Every device fact carries a provenance chain — (path, line, description)
+steps from the origin to the sync site — surfaced as ``Finding.trace``
+and printed by the CLI's ``--explain``.
+
+Call resolution for taint is *exact-only* (module functions, imported
+project functions, ``self.method`` on the caller's own class): the
+thread-safety walk wants conservative fan-out, but taint powering a lint
+on hot files must not let one project function named ``get`` taint every
+``obj.get()`` in the tree. Unresolvable calls fall back to v1 semantics:
+the call is tainted iff an argument is.
+
+Summaries are a fixpoint over the call graph (taint only grows, so
+recursion converges), then a second fixpoint pushes caller-argument
+taint into callees. The project graph is a few hundred functions; the
+whole pass stays inside bench.py's ``analysis_overhead`` budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from video_features_tpu.analysis.callgraph import CallGraph, FunctionInfo
+from video_features_tpu.analysis.core import (
+    SourceFile,
+    import_aliases,
+    jit_decoration,
+    param_names,
+    resolve_dotted,
+)
+
+# jax calls whose results are HOST values (never taint). Includes the
+# multihost collectives whose JOB is a host-level agreement: PR 4 waived
+# ``broadcast_one_to_all`` at its one call site; v2 encodes the fact
+# instead — the result is a host-side numpy value every process agrees
+# on, and flagging the ``bool()`` around it taught nothing.
+_HOST_RESULTS = frozenset(
+    {
+        "jax.device_get",
+        "jax.process_index",
+        "jax.process_count",
+        "jax.device_count",
+        "jax.local_device_count",
+        "jax.devices",
+        "jax.local_devices",
+        "jax.default_backend",
+        "jax.eval_shape",
+        "jax.experimental.multihost_utils.broadcast_one_to_all",
+        "jax.experimental.multihost_utils.process_allgather",
+    }
+)
+_FETCHERS = frozenset({"numpy.asarray", "numpy.array", "jax.device_get"})
+_DEVICE_HEADS = ("jax", "lax", "flax")
+# array metadata lives on the HOST even for device arrays: geometry
+# derived from .shape/.ndim/.dtype never syncs (jit_hygiene GC202 makes
+# the same trace-time-static call for branch conditions)
+_HOST_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "nbytes", "itemsize", "sharding"}
+)
+
+Step = Tuple[str, int, str]  # (path, line, description)
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    """Taint of one value: device-ness (with provenance) plus which of
+    the enclosing function's parameters flow into it (for summaries)."""
+
+    device: bool = False
+    params: frozenset = frozenset()
+    chain: Tuple[Step, ...] = ()
+
+    def __or__(self, other: "Taint") -> "Taint":
+        return Taint(
+            device=self.device or other.device,
+            params=self.params | other.params,
+            chain=self.chain if self.device else other.chain,
+        )
+
+
+EMPTY = Taint()
+
+
+def _device(chain: Tuple[Step, ...]) -> Taint:
+    return Taint(device=True, chain=chain)
+
+
+@dataclasses.dataclass
+class Summary:
+    """What a function's RETURN value carries: device taint (with the
+    chain back to its origin) and/or parameter indices that flow out."""
+
+    returns: Taint = EMPTY
+
+
+class ProjectTaint:
+    """Shared taint state over one ``run_checks`` source set."""
+
+    def __init__(self, sources: Sequence[SourceFile], graph: CallGraph) -> None:
+        self.sources = list(sources)
+        self.graph = graph
+        self.summaries: Dict[str, Summary] = {}
+        # externally induced param taint: key -> {param index: chain}
+        self.param_taint: Dict[str, Dict[int, Tuple[Step, ...]]] = {}
+        # post-fixpoint name envs (closures inherit; hostsync flags from)
+        self._env: Dict[str, Dict[str, Taint]] = {}
+        self._module_env: Dict[str, Dict[str, Taint]] = {}
+        self._aliases = {s.rel: import_aliases(s.tree) for s in sources}
+        self._compute()
+
+    # --- public API ---------------------------------------------------------
+
+    def env_for(self, key: str) -> Dict[str, Taint]:
+        return self._env.get(key, {})
+
+    def module_env(self, src: SourceFile) -> Dict[str, Taint]:
+        return self._module_env.get(src.rel, {})
+
+    def expr_taint(
+        self,
+        node: ast.AST,
+        env: Dict[str, Taint],
+        src: SourceFile,
+        info: Optional[FunctionInfo],
+    ) -> Taint:
+        return self._expr(node, env, src, info)
+
+    # --- fixpoints ----------------------------------------------------------
+
+    def _compute(self) -> None:
+        order = self._definition_order()
+        for _ in range(5):  # summary fixpoint
+            self._scan_modules()
+            changed = False
+            for info in order:
+                taints, ret = self._scan(info)
+                self._env[info.key] = taints
+                old = self.summaries.get(info.key)
+                if old is None or old.returns != ret:
+                    self.summaries[info.key] = Summary(ret)
+                    changed = True
+            if not changed:
+                break
+        for _ in range(5):  # caller-arg -> callee-param fixpoint
+            pushed = False
+            for info in order:
+                if self._push_args(info, self._env[info.key]):
+                    pushed = True
+            if not pushed:
+                break
+            self._scan_modules()
+            for info in order:
+                taints, ret = self._scan(info)
+                self._env[info.key] = taints
+                self.summaries[info.key] = Summary(ret)
+
+    def _definition_order(self) -> List[FunctionInfo]:
+        # outer before inner, so closure envs exist when nested defs scan
+        return sorted(
+            self.graph.functions.values(),
+            key=lambda f: (f.src.rel, f.node.lineno, f.node.col_offset),
+        )
+
+    def _scan_modules(self) -> None:
+        for src in self.sources:
+            env = self._module_env.setdefault(src.rel, {})
+            flat = flatten_body(src.tree.body)
+            for _ in range(2):
+                if not self._assign_pass(flat, env, src, None):
+                    break
+
+    def _push_args(self, info: FunctionInfo, taints: Dict[str, Taint]) -> bool:
+        changed = False
+        for site in self.graph.calls.get(info.key, ()):
+            callee = self.graph.functions.get(site.callee)
+            if callee is None:
+                continue
+            pnames = param_names(callee.node)
+            skip = 1 if callee.cls and pnames and pnames[0] in ("self", "cls") else 0
+            for i, arg in enumerate(site.node.args):
+                t = self._expr(arg, taints, info.src, info)
+                if not t.device:
+                    continue
+                idx = i + skip
+                if idx >= len(pnames):
+                    break
+                slot = self.param_taint.setdefault(callee.key, {})
+                if idx not in slot:
+                    slot[idx] = t.chain + (
+                        (
+                            info.src.path,
+                            site.node.lineno,
+                            f"passed to {callee.name}() as {pnames[idx]!r}",
+                        ),
+                    )
+                    changed = True
+        return changed
+
+    # --- per-function scan --------------------------------------------------
+
+    def initial_taints(self, info: FunctionInfo) -> Dict[str, Taint]:
+        taints: Dict[str, Taint] = {}
+        names = param_names(info.node)
+        site = jit_decoration(info.node, self._aliases[info.src.rel])
+        static = set(site.static_argnames) if site else set()
+        for i, p in enumerate(names):
+            t = Taint(params=frozenset({i}))
+            if site is not None and p not in static:
+                t = t | _device(
+                    ((info.src.path, info.node.lineno,
+                      f"parameter {p!r} of jitted {info.name!r}"),)
+                )
+            ext = self.param_taint.get(info.key, {}).get(i)
+            if ext is not None:
+                t = t | _device(ext)
+            taints[p] = t
+        # closure inheritance: enclosing scope's device taints flow in,
+        # minus names this function binds itself (params / assignments)
+        outer = (
+            self._env.get(info.parent)
+            if info.parent
+            else self._module_env.get(info.src.rel)
+        )
+        if outer:
+            bound = set(names) | _assigned_names(info.node)
+            for n, t in outer.items():
+                if n not in bound and t.device:
+                    taints[n] = Taint(device=True, chain=t.chain)
+        return taints
+
+    def _scan(self, info: FunctionInfo) -> Tuple[Dict[str, Taint], Taint]:
+        taints = self.initial_taints(info)
+        flat = flatten_body(info.node.body)
+        for _ in range(4):
+            if not self._assign_pass(flat, taints, info.src, info):
+                break
+        ret = EMPTY
+        for st in flat:
+            if isinstance(st, ast.Return) and st.value is not None:
+                ret = ret | self._expr(st.value, taints, info.src, info)
+        return taints, ret
+
+    def _assign_pass(
+        self,
+        flat: List[ast.stmt],
+        taints: Dict[str, Taint],
+        src: SourceFile,
+        info: Optional[FunctionInfo],
+    ) -> bool:
+        changed = False
+        for st in flat:
+            if not isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            value = st.value
+            if value is None:
+                continue
+            t = self._expr(value, taints, src, info)
+            if not t.device and not t.params:
+                continue
+            targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+            for tgt in targets:
+                for n in _target_names(tgt):
+                    old = taints.get(n, EMPTY)
+                    new = old | (
+                        Taint(
+                            device=True,
+                            params=t.params,
+                            chain=t.chain
+                            + ((src.path, st.lineno, f"assigned to {n!r}"),),
+                        )
+                        if t.device
+                        else t
+                    )
+                    if new != old:
+                        taints[n] = new
+                        changed = True
+        return changed
+
+    # --- expression taint ---------------------------------------------------
+
+    def _taint_callees(
+        self, func: ast.AST, src: SourceFile, info: Optional[FunctionInfo]
+    ) -> List[str]:
+        """Exact-only callee resolution (no by-name fan-out): module and
+        imported project functions, nested defs, ``self.method`` on the
+        caller's own class."""
+        graph = self.graph
+        if isinstance(func, ast.Name):
+            keys, _ = graph.resolve_call(func, src, info)
+            return keys
+        if isinstance(func, ast.Attribute):
+            aliases = self._aliases[src.rel]
+            rd = resolve_dotted(func.value, aliases)
+            if rd is not None:
+                m = graph.resolve_module(rd)
+                if m is not None:
+                    hit = graph.module_function(m, func.attr)
+                    if hit:
+                        return [hit]
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls")
+                and info is not None
+                and info.cls is not None
+            ):
+                own = graph.methods_of.get((src.rel, info.cls, func.attr))
+                if own:
+                    return [own]
+            return []
+        if isinstance(func, ast.Call):
+            rd = resolve_dotted(func.func, self._aliases[src.rel])
+            if rd in ("functools.partial", "partial") and func.args:
+                return self._taint_callees(func.args[0], src, info)
+        return []
+
+    def _expr(
+        self,
+        node: ast.AST,
+        taints: Dict[str, Taint],
+        src: SourceFile,
+        info: Optional[FunctionInfo],
+    ) -> Taint:
+        """Taint of evaluating ``node``: device origin + param flow."""
+        aliases = self._aliases[src.rel]
+
+        if isinstance(node, ast.Name):
+            return taints.get(node.id, EMPTY)
+        if isinstance(node, ast.Attribute) and node.attr in _HOST_ATTRS:
+            return EMPTY  # metadata access: host-side even on device arrays
+        if isinstance(node, ast.Call):
+            rd = resolve_dotted(node.func, aliases)
+            if rd is not None:
+                if rd in _HOST_RESULTS or rd in _FETCHERS:
+                    return EMPTY  # the result lives on the host
+                if rd.split(".")[0] in _DEVICE_HEADS:
+                    return _device(
+                        ((src.path, node.lineno,
+                          f"{rd}(...) creates a device value"),)
+                    )
+            callees = [
+                c
+                for c in self._taint_callees(node.func, src, info)
+                if c in self.summaries
+            ]
+            if callees:
+                out = EMPTY
+                for ck in callees:
+                    summ = self.summaries[ck].returns
+                    callee = self.graph.functions[ck]
+                    if summ.device:
+                        out = out | _device(
+                            summ.chain + (
+                                (src.path, node.lineno,
+                                 f"device value returned by {callee.name}()"),
+                            )
+                        )
+                    pnames = param_names(callee.node)
+                    skip = (
+                        1 if callee.cls and pnames
+                        and pnames[0] in ("self", "cls") else 0
+                    )
+                    for idx in summ.params:
+                        a = idx - skip
+                        if 0 <= a < len(node.args):
+                            t = self._expr(node.args[a], taints, src, info)
+                            if t.device:
+                                out = out | _device(
+                                    t.chain + (
+                                        (src.path, node.lineno,
+                                         f"flows through {callee.name}() "
+                                         "back to the caller"),
+                                    )
+                                )
+                            out = out | Taint(params=t.params)
+                # a resolved project call: the summary IS the answer
+                return out
+        # default: union over child expressions (method calls on tainted
+        # objects, binops, subscripts, f-strings ... all propagate)
+        out = EMPTY
+        for child in ast.iter_child_nodes(node):
+            out = out | self._expr(child, taints, src, info)
+        return out
+
+
+# --- shared AST plumbing ----------------------------------------------------
+
+def flatten_body(body: List[ast.stmt]) -> List[ast.stmt]:
+    """Every statement in ``body`` transitively, EXCLUDING nested defs
+    (separate call-graph nodes with closure-inherited envs). Class bodies
+    stay in the enclosing scope, as in v1."""
+    flat: List[ast.stmt] = []
+
+    def go(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            flat.append(st)
+            for field in ("body", "orelse", "finalbody"):
+                go(getattr(st, field, []) or [])
+            for h in getattr(st, "handlers", []) or []:
+                go(h.body)
+            for case in getattr(st, "cases", []) or []:
+                go(case.body)
+
+    go(body)
+    return flat
+
+
+def _target_names(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in t.elts:
+            out.extend(_target_names(el))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+def _assigned_names(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for st in flatten_body(fn.body):
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+            for t in targets:
+                out.update(_target_names(t))
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            out.update(_target_names(st.target))
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                if item.optional_vars is not None:
+                    out.update(_target_names(item.optional_vars))
+    return out
+
+
+def format_chain(chain: Tuple[Step, ...]) -> List[str]:
+    return [f"{path}:{line}: {desc}" for path, line, desc in chain]

@@ -44,6 +44,15 @@ DEVICE_PREPROCESS_FEATURE_TYPES = (
     CLIP_FEATURE_TYPES + RESNET_FEATURE_TYPES + ["raft", "pwc", "i3d"]
 )
 
+# extractors whose fused --preprocess device entry also satisfies the
+# GC50x sharding contract under --sharding mesh: the frame-batch axis
+# shards over 'data' with explicit in_shardings/out_shardings and the
+# resample taps replicate (models/clip/extract_clip.py encode_raw).
+# The other device-preprocess extractors keep their single-device fused
+# path (their _build guards it with `not is_mesh(device)`), so mesh+device
+# stays rejected for them until their entries carry the contract too.
+MESH_DEVICE_PREPROCESS_FEATURE_TYPES = list(CLIP_FEATURE_TYPES)
+
 
 @dataclass
 class ExtractionConfig:
@@ -350,11 +359,21 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
                 "drop one of the two flags"
             )
         if cfg.sharding == "mesh":
-            raise ValueError(
-                "--preprocess device does not compose with --sharding "
-                "mesh yet (the raw-frame dispatch is not sharded; "
-                "ROADMAP open item)"
-            )
+            if cfg.feature_type not in MESH_DEVICE_PREPROCESS_FEATURE_TYPES:
+                supported = ", ".join(sorted(MESH_DEVICE_PREPROCESS_FEATURE_TYPES))
+                raise ValueError(
+                    "--preprocess device under --sharding mesh needs the "
+                    "fused entry to declare its sharding contract (GC502); "
+                    f"today that covers: {supported} "
+                    f"(got {cfg.feature_type!r})"
+                )
+            if cfg.mesh_context:
+                raise ValueError(
+                    "--preprocess device shards the raw frame axis over "
+                    "'data'; --mesh_context replicates the batch and "
+                    "shards tokens in-model — the two layouts conflict, "
+                    "drop one"
+                )
     if cfg.spatial_bucket < 1:
         raise ValueError(f"spatial_bucket must be >= 1, got {cfg.spatial_bucket}")
     if cfg.compile_cache_min_s < 0:

@@ -153,9 +153,9 @@ class BaseExtractor:
         if multihost() and self.config.sharding == "mesh":
             from jax.experimental import multihost_utils
 
-            # graftcheck: host-sync — the blocking collective IS the point:
-            # every process must agree on the skip decision before any of
-            # them dispatches, so this sync sits outside the hot loop
+            # the blocking collective IS the point: every process must
+            # agree on the skip decision before any of them dispatches
+            # (taint knows broadcast_one_to_all yields a HOST value)
             done = bool(
                 multihost_utils.broadcast_one_to_all(np.int32(done))
             )

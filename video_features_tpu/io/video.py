@@ -57,8 +57,6 @@ def set_decoder(name: str) -> None:
     global _DECODER
     if name not in ("auto", "cv2", "native"):
         raise ValueError(f"unknown decoder backend: {name!r}")
-    # graftcheck: unlocked — config-set-once from sanity_check before any
-    # decode worker thread exists; readers only ever observe one value
     _DECODER = name
 
 
@@ -69,8 +67,6 @@ def set_decode_timeout(seconds: Optional[float]) -> None:
     choice: the readers are constructed deep inside samplers that don't
     thread config through."""
     global _DECODE_TIMEOUT
-    # graftcheck: unlocked — config-set-once alongside _DECODER above;
-    # a float/None rebind is atomic and no reader mixes old/new state
     _DECODE_TIMEOUT = float(seconds) if seconds else None
 
 

@@ -159,13 +159,12 @@ class ExtractCLIP(BaseExtractor):
 
         state = {"params": params, "encode_image": encode_image,
                  "device": device, "pad_data": not context}
-        if self._device_preprocess_enabled() and not is_mesh(device):
+        if self._device_preprocess_enabled():
             # --preprocess device: raw uint8 HWC frames + the per-video
             # banded resize/crop taps enter as jit INPUTS, so one
             # executable serves every source resolution in a spatial
             # bucket. The fused program: resize+crop (two K-tap banded
             # passes) -> normalize -> encoder forward, one dispatch.
-            @jax.jit
             def encode_raw(p, x_u8, wy, wx):
                 x = device_preprocess_frames(
                     x_u8, wy, wx, CLIP_MEAN, CLIP_STD, out_dtype=dt
@@ -174,6 +173,30 @@ class ExtractCLIP(BaseExtractor):
                     x = x.reshape((-1,) + x.shape[2:])
                 return model.apply({"params": p}, x)
 
+            if is_mesh(device):
+                # mesh + device preprocess (sanity_check admits CLIP
+                # only): the frame axis shards over 'data' — each shard
+                # resizes and encodes its own frame slice, the taps
+                # replicate. Explicit in/out shardings are the GC502
+                # contract: params inherit their TP placement (None),
+                # frames split over 'data' (place_raw_payload padded the
+                # axis divisible pre-split), taps replicate.
+                from jax.sharding import NamedSharding, PartitionSpec
+                from video_features_tpu.parallel.sharding import (
+                    _mesh_out_sharding,
+                )
+
+                batch_sh = NamedSharding(device, PartitionSpec("data"))
+                rep = NamedSharding(device, PartitionSpec())
+                encode_raw = jax.jit(
+                    encode_raw,
+                    in_shardings=(None, batch_sh, (rep, rep), (rep, rep)),
+                    out_shardings=_mesh_out_sharding(
+                        device, PartitionSpec("data")
+                    ),
+                )
+            else:
+                encode_raw = jax.jit(encode_raw)
             state["encode_raw"] = encode_raw
         return state
 
@@ -252,7 +275,9 @@ class ExtractCLIP(BaseExtractor):
     def dispatch_prepared(self, device, state, path_entry, payload):
         padded, T, fps, timestamps_ms = payload
         if isinstance(padded, tuple):  # --preprocess device
-            x_u8, wy, wx = jax.device_put(padded, state["device"])
+            from video_features_tpu.parallel.sharding import place_raw_payload
+
+            x_u8, wy, wx = place_raw_payload(padded, state["device"])
             out = state["encode_raw"](state["params"], x_u8, wy, wx)
             return out, T, fps, timestamps_ms
         x = self._place(state, padded)
@@ -278,6 +303,11 @@ class ExtractCLIP(BaseExtractor):
     def agg_key(self, payload):
         head = payload[0]
         if isinstance(head, tuple):  # --preprocess device: bucketed uint8
+            if self.config.sharding == "mesh":
+                # mesh already spreads ONE video's frame axis over
+                # 'data'; cross-video fusion would stack an N axis the
+                # encode_raw in_shardings contract does not cover
+                return None
             if head[0].shape[0] > self.AGG_MAX_FRAMES:
                 return None
             # the spatial bucket rides the key via the frame shape, so
@@ -303,7 +333,11 @@ class ExtractCLIP(BaseExtractor):
                 xs = pad_batch(xs, group)
                 wys = tuple(pad_batch(a, group) for a in wys)
                 wxs = tuple(pad_batch(a, group) for a in wxs)
-            xs, wys, wxs = jax.device_put((xs, wys, wxs), state["device"])
+            from video_features_tpu.parallel.sharding import place_raw_payload
+
+            # mesh never groups (agg_key returns None there), so this is
+            # always the plain queue-mode device_put of the fused tuple
+            xs, wys, wxs = place_raw_payload((xs, wys, wxs), state["device"])
             out = state["encode_raw"](state["params"], xs, wys, wxs)
             metas = [(i * bucket, p[1], p[2], p[3]) for i, p in enumerate(payloads)]
             return out, metas

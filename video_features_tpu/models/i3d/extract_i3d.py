@@ -305,13 +305,16 @@ class ExtractI3D(BaseExtractor):
         )
         fns = {}
 
-        if key == ("dev",):
+        if key == ("dev",) and not is_mesh(state["device"]):
             # shape-contracted device preprocess: ONE set of jitted fns
             # regardless of source resolution — the taps, raw uint8
             # stacks, and crop offsets are all INPUTS, so jax.jit's own
             # shape cache compiles one executable per (input bucket,
             # output grid) contract rather than per source shape.
-            # sanity_check guarantees flow_type raft/pwc and no mesh.
+            # sanity_check guarantees flow_type raft/pwc and no mesh for
+            # I3D device preprocess; the `not is_mesh` conjunct makes that
+            # visible to GC50x (these plain @jax.jit entries are
+            # single-device by construction).
             from video_features_tpu.ops.preprocess import (
                 device_resize_frames,
                 dynamic_center_crop,

@@ -71,6 +71,7 @@ class ExtractResNet(BaseExtractor):
         )
 
         from video_features_tpu.parallel.sharding import (
+            is_mesh,
             jit_sharded_forward,
             place_params,
         )
@@ -87,8 +88,9 @@ class ExtractResNet(BaseExtractor):
 
         forward = jit_sharded_forward(forward, device, n_out=2)
         state = {"params": params, "forward": forward, "device": device}
-        if self._device_preprocess_enabled():
-            # --preprocess device (sanity_check already excludes mesh):
+        if self._device_preprocess_enabled() and not is_mesh(device):
+            # --preprocess device (sanity_check excludes mesh for ResNet;
+            # the `not is_mesh` conjunct makes that visible to GC50x):
             # raw uint8 frames + the video's banded resize/crop taps fuse
             # the bilinear-256/crop-224/normalize chain into the forward
             @jax.jit

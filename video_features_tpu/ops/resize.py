@@ -287,7 +287,15 @@ def fused_resize_crop_banded(
     (min-edge/resize_to) is maximal at the bucket corner, so every source
     resolution sharing a bucket pads up to one static K — mixed-resolution
     ``--video_batch`` groups can stack their taps, and one executable
-    serves the whole bucket."""
+    serves the whole bucket.
+
+    Sharding contract (--sharding mesh): the taps are per-PIXEL geometry,
+    identical for every frame, so they replicate (PartitionSpec()) while
+    the frame batch axis of the uint8 input shards over 'data' — with the
+    bucket pad applied BEFORE the split so every shard sees the same
+    static (pad_h, pad_w, K) shapes. parallel.sharding.place_raw_payload
+    implements the placement; GC502 statically checks that every fused
+    jit entry reachable under mesh pins it via in/out_shardings."""
     wy, wx = fused_resize_crop_matrices(
         h, w, resize_to, crop, method, pad_h, pad_w, crop_offset
     )

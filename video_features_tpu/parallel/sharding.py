@@ -188,6 +188,28 @@ def jit_sharded_forward(fn, device, n_out: int = 1):
     return jax.jit(fn, out_shardings=out if n_out == 1 else (out,) * n_out)
 
 
+def place_raw_payload(payload, device):
+    """Transfer one ``--preprocess device`` payload — the
+    ``(frames, (wt_y, idx_y), (wt_x, idx_x))`` triple from the host half.
+
+    Queue mode: one plain ``device_put`` of the whole tuple. Mesh: the
+    uint8 frame axis (axis 0 — already time-bucket padded by the
+    extractor's ``prepare``, so the pad rows exist BEFORE the shard
+    split) rounds up to 'data'-divisible, frames shard over 'data', and
+    the per-resolution resample taps replicate — every shard resizes its
+    own frame slice against the full tap tables (the taps are K x size,
+    kilobytes next to the frames). The caller's row count slices the pad
+    rows off at fetch, same as the host-preprocess mesh path.
+    """
+    if not is_mesh(device):
+        return jax.device_put(payload, device)
+    frames, wy, wx = payload
+    frames = pad_batch_for(device, frames)
+    batch = NamedSharding(device, P("data"))
+    rep = NamedSharding(device, P())
+    return jax.device_put((frames, wy, wx), (batch, (rep, rep), (rep, rep)))
+
+
 def place_batch(x, device, spec=P("data")):
     """Transfer one input batch: device_put for a single device, sharded
     device_put over the mesh (axis 0 must already divide — see

@@ -254,8 +254,6 @@ def install_injector(specs: Optional[Sequence[str]]) -> None:
     wins, which is exactly the one-run-per-process CLI lifecycle."""
     global _INJECTOR
     parsed = parse_fault_specs(specs)
-    # graftcheck: unlocked — test-only set-once per the docstring; workers
-    # read through fire()'s local snapshot, never a torn half-install
     _INJECTOR = FaultInjector(parsed) if parsed else None
 
 
