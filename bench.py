@@ -173,6 +173,10 @@ def bench_clip(
     # full (video_batch*bucket) shape — the same executable the timed
     # groups use.
     ex(range(min(2, n_videos)), device=device)
+    # telemetry spans from the timed passes only (seq0 fences off the
+    # warmup, whose compile-dominated dispatch spans would skew the
+    # overlap-efficiency report)
+    seq0 = max((r["seq"] for r in ex.telemetry.spans()), default=0)
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -181,7 +185,14 @@ def bench_clip(
     assert len(results) == n_videos and all(
         r["CLIP-ViT-B/32"].shape == (12, 512) for r in results
     )
-    return _pass_stats(n_videos, times)
+    stats = _pass_stats(n_videos, times)
+    from video_features_tpu.runtime.telemetry import overlap_report
+
+    rep = overlap_report([r for r in ex.telemetry.spans() if r["seq"] > seq0])
+    stats["overlap"] = {
+        k: (round(v, 4) if isinstance(v, float) else v) for k, v in rep.items()
+    }
+    return stats
 
 
 def bench_i3d_raft(
@@ -754,6 +765,11 @@ def _sub_clip_mixed() -> dict:
         "clip_mixed_device_speedup_vs_host": round(
             dev["best"] / host["best"], 3
         ),
+        # pipelined mixed-resolution overlap efficiency (runtime/
+        # telemetry.py::overlap_report): the measurement baseline the
+        # async double-buffered ingest ROADMAP item is judged against
+        "clip_mixed_host_overlap": host.get("overlap"),
+        "clip_mixed_device_overlap": dev.get("overlap"),
     }
 
 
@@ -962,6 +978,60 @@ def _sub_fault_overhead() -> dict:
     return out
 
 
+def _sub_telemetry_overhead() -> dict:
+    """Happy-path cost of structured telemetry (runtime/telemetry.py):
+    per video the pipelined loop opens ~5 spans (decode/prepare/dispatch/
+    fetch/sink), bumps counters/gauges, and buffers the rows for the
+    shared drain thread. Measured as on-minus-off over the same span
+    shape — 'off' is the --telemetry off degradation (bare StageTimer
+    timing, the pre-telemetry behaviour) — and reported in us/video and
+    as a percentage of the r01 CLIP chip headline (3.637 videos/s ->
+    ~275 ms/video), pinning ISSUE 6's <1% ceiling."""
+    import timeit
+
+    from video_features_tpu.runtime.telemetry import Telemetry
+
+    n = 2000
+    payload = np.zeros((12, 224, 224, 3), dtype=np.uint8)
+
+    def one_video(t, key):
+        with t.span("prepare", video=key, attempt=1, worker="w0"):
+            with t.span("decode", video=key):
+                t.metrics.inc("frames_decoded", 12)
+        with t.span("dispatch", video=key, attempt=1, worker="w0"):
+            t.count_h2d(payload)
+        with t.span("fetch", video=key, attempt=1, worker="w0"):
+            pass
+        with t.span("sink", video=key):
+            pass
+        t.metrics.inc("videos_done")
+        t.metrics.set_gauge("queue_depth.pending", 3)
+
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        tele_off = Telemetry(enabled=False)
+        tele_on = Telemetry(output_root=tmp, enabled=True)
+        seq = iter(range(n * 4))
+        off_s = timeit.timeit(
+            lambda: one_video(tele_off, f"/videos/{next(seq)}.mp4"), number=n
+        )
+        on_s = timeit.timeit(
+            lambda: one_video(tele_on, f"/videos/{next(seq)}.mp4"), number=n
+        )
+        tele_on.close()
+        spans_written = len(tele_on.spans())
+    delta_us = max(on_s - off_s, 0.0) / n * 1e6
+    headline_s_per_video = 1.0 / 3.637  # BENCH_r01 chip headline
+    pct = delta_us / 1e6 / headline_s_per_video * 100.0
+    out["telemetry_on_us_per_video"] = round(on_s / n * 1e6, 2)
+    out["telemetry_off_us_per_video"] = round(off_s / n * 1e6, 2)
+    out["telemetry_overhead_us_per_video"] = round(delta_us, 2)
+    out["telemetry_overhead_pct_vs_headline"] = round(pct, 4)
+    out["telemetry_within_budget"] = pct < 1.0
+    out["telemetry_spans_written"] = spans_written
+    return out
+
+
 def _sub_analysis_overhead() -> dict:
     """Wall-time of a full graftcheck sweep (docs/analysis.md): the
     static-analysis suite is meant to run on every push via
@@ -1004,6 +1074,7 @@ SUB_PARTS = {
     "pallas_corr": lambda: bench_pallas_corr(),
     "flash_attention": lambda: bench_flash_attention(),
     "fault_overhead": _sub_fault_overhead,
+    "telemetry_overhead": _sub_telemetry_overhead,
     "analysis_overhead": _sub_analysis_overhead,
 }
 
@@ -1171,6 +1242,10 @@ def main() -> None:
     # pure-host like the pipeline part: the fault-tolerance bookkeeping
     # cost (fire() no-ops + manifest appends) vs the chip headline
     extra.update(_spawn_sub("fault_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
+    emit()
+    # same contract for the telemetry spans/metrics bookkeeping (ISSUE 6
+    # <1% ceiling, on-minus-off vs the --telemetry off degradation)
+    extra.update(_spawn_sub("telemetry_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
     # graftcheck latency budget (pure host: AST only, no device work)
     extra.update(_spawn_sub("analysis_overhead", 120.0, env={"JAX_PLATFORMS": "cpu"}))

@@ -165,6 +165,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "device_preprocess": {"device_preprocess_fps": 11.0},
         "fault_overhead": {"fault_bookkeeping_us_per_video": 12.0},
         "analysis_overhead": {"analysis_graftcheck_cold_s": 0.7},
+        "telemetry_overhead": {"telemetry_overhead_us_per_video": 15.0},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -195,6 +196,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["host_pipeline"]["device_preprocess_fps"] == 11.0
     assert final["extra"]["fault_bookkeeping_us_per_video"] == 12.0
     assert final["extra"]["analysis_graftcheck_cold_s"] == 0.7
+    assert final["extra"]["telemetry_overhead_us_per_video"] == 15.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -226,6 +228,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"fault_bookkeeping_us_per_video": 12.0}
         if name == "analysis_overhead":  # pure-AST graftcheck sweep, no device
             return {"analysis_graftcheck_cold_s": 0.7}
+        if name == "telemetry_overhead":  # span engine micro-bench, CPU-pinned
+            return {"telemetry_overhead_us_per_video": 15.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

@@ -65,6 +65,64 @@ def test_device_trace_releases_ref_when_body_raises(fake_profiler):
     assert profiling._trace_refs == 0
 
 
+def test_device_trace_creates_missing_profile_dir(fake_profiler, tmp_path):
+    target = tmp_path / "nested" / "prof"
+    with device_trace(str(target)):
+        pass
+    assert target.is_dir()
+    assert fake_profiler.events == [("start", str(target)), ("stop", None)]
+
+
+def test_device_trace_failed_start_leaves_clean_state(monkeypatch, tmp_path):
+    """start_trace raising (unwritable dir, wedged profiler) must not
+    leak a ref or a half-started session: the very next caller has to be
+    able to start cleanly instead of deadlocking or double-starting."""
+    import jax
+
+    events = []
+    broken = {"on": True}
+
+    def start_trace(d):
+        if broken["on"]:
+            raise RuntimeError("profiler wedged")
+        events.append(("start", d))
+
+    monkeypatch.setattr(jax.profiler, "start_trace", start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: events.append(("stop", None)))
+    assert profiling._trace_refs == 0
+    with pytest.raises(RuntimeError, match="wedged"):
+        with device_trace(str(tmp_path)):
+            pass
+    assert profiling._trace_refs == 0
+    # cleanup stopped the (possibly half-started) session best-effort
+    assert events == [("stop", None)]
+    broken["on"] = False
+    events.clear()
+    with device_trace(str(tmp_path)):
+        assert profiling._trace_refs == 1
+    assert events == [("start", str(tmp_path)), ("stop", None)]
+    assert profiling._trace_refs == 0
+
+
+def test_device_trace_failed_start_cleanup_error_not_masking(monkeypatch, tmp_path):
+    """stop_trace raising during failed-start cleanup (nothing was
+    running) must not mask the original start error."""
+    import jax
+
+    def start_trace(d):
+        raise RuntimeError("no space left on device")
+
+    def stop_trace():
+        raise ValueError("no profiler session running")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", stop_trace)
+    with pytest.raises(RuntimeError, match="no space"):
+        with device_trace(str(tmp_path)):
+            pass
+    assert profiling._trace_refs == 0
+
+
 def test_device_trace_concurrent_workers_one_start_one_stop(fake_profiler):
     """8 threads racing through the region: exactly one start, exactly
     one stop, and every interleaving keeps the refcount consistent."""

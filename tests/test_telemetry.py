@@ -1,0 +1,540 @@
+"""Structured telemetry (ISSUE 6): span JSONL schema, nested +
+cross-thread emission under the pipelined loop, overlap-efficiency math
+on synthetic fixtures, Chrome-trace export, the summary.json telemetry
+block, failure-record span linkage, the recompile watch, and the
+``python -m video_features_tpu.telemetry`` consumers.
+
+A toy extractor (same shape as tests/test_faults.py) drives the real
+pipelined loop once per module; the span files it leaves under
+``<out>/_telemetry/`` are the fixture most tests read."""
+
+import glob
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig, sanity_check
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import stream_frames
+from video_features_tpu.runtime import faults
+from video_features_tpu.runtime import telemetry as tm
+from video_features_tpu.telemetry import SCHEMA_PATH, load_schema
+from video_features_tpu.telemetry.__main__ import main as tele_main
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_telemetry_state():
+    """set_current / the fault injector are process-global latest-wins;
+    never leak one test's extractor into the rest of the suite."""
+    yield
+    tm.set_current(None)
+    faults.install_injector(None)
+
+
+@pytest.fixture(scope="module")
+def toy_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    d = tmp_path_factory.mktemp("tele_media")
+    return [
+        synth_video(str(d / f"v{i}.mp4"), n_frames=8, width=64, height=48, seed=i)
+        for i in range(3)
+    ]
+
+
+class ToyExtractor(BaseExtractor):
+    feature_type = "toy"
+
+    def _build(self, device):
+        return {"device": device}
+
+    def prepare(self, path_entry):
+        vals = [float(frame.mean()) for frame, _ in stream_frames(video_path_of(path_entry))]
+        return np.asarray(vals, dtype=np.float32)
+
+    def extract_prepared(self, device, state, path_entry, payload):
+        return {
+            "toy": np.asarray(payload).reshape(-1, 1),
+            "fps": 25.0,
+            "timestamps_ms": np.arange(len(payload), dtype=np.float64),
+        }
+
+
+class ToyAgg(ToyExtractor):
+    def agg_key(self, payload):
+        return np.asarray(payload).shape
+
+    def dispatch_group(self, device, state, entries, payloads):
+        return [
+            ToyExtractor.extract_prepared(self, device, state, e, p)
+            for e, p in zip(entries, payloads)
+        ]
+
+    def fetch_group(self, handle):
+        return handle
+
+
+def _cfg(videos, out_dir, **kw):
+    kw.setdefault("decode_workers", 1)
+    kw.setdefault("retry_backoff", 0.01)
+    return ExtractionConfig(
+        allow_random_init=True,
+        video_paths=list(videos),
+        on_extraction="save_numpy",
+        output_path=str(out_dir / "out"),
+        tmp_path=str(out_dir / "tmp"),
+        cpu=True,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def agg_run(tmp_path_factory, toy_videos):
+    """One real pipelined + aggregated run (2 decode workers,
+    --video_batch 2): the span files, summary, and config most tests
+    below assert against."""
+    tmp = tmp_path_factory.mktemp("tele_run")
+    cfg = _cfg(toy_videos, tmp, decode_workers=2, video_batch=2)
+    ex = ToyAgg(cfg)
+    ex()
+    ex.telemetry.close()
+    summary = faults.finalize_run(cfg.output_path)
+    files = sorted(glob.glob(os.path.join(cfg.output_path, "_telemetry", "spans-*.jsonl")))
+    rows = [r for f in files for r in tm.read_spans(f)]
+    tm.set_current(None)
+    return SimpleNamespace(cfg=cfg, rows=rows, summary=summary, files=files)
+
+
+# --- span JSONL schema -------------------------------------------------------
+
+
+def test_spans_schema_is_itself_valid():
+    jsonschema = pytest.importorskip("jsonschema")
+    schema = load_schema()
+    jsonschema.Draft7Validator.check_schema(schema)
+    assert os.path.basename(SCHEMA_PATH) == "spans_schema.json"
+    assert set(schema["properties"]["stage"]["enum"]) == set(tm.STAGES)
+
+
+def test_run_spans_validate_against_committed_schema(agg_run):
+    jsonschema = pytest.importorskip("jsonschema")
+    validator = jsonschema.Draft7Validator(load_schema())
+    assert agg_run.rows, "pipelined run recorded no spans"
+    for row in agg_run.rows:
+        validator.validate(row)
+
+
+def test_run_emits_every_hot_path_stage(agg_run):
+    stages = {r["stage"] for r in agg_run.rows}
+    # decode (io/ reader), prepare (decode workers), dispatch/fetch
+    # (group path), sink — the full pipelined hot path
+    assert {"decode", "prepare", "dispatch", "fetch", "sink"} <= stages
+    # every span is a closed interval with a monotonic clock
+    for r in agg_run.rows:
+        assert r["t1"] >= r["t0"]
+    # ids are unique and sequenced within the run
+    ids = [r["span"] for r in agg_run.rows]
+    assert len(ids) == len(set(ids))
+
+
+def test_cross_thread_and_nested_spans_under_pipelined_loop(agg_run):
+    by_id = {r["span"]: r for r in agg_run.rows}
+    prepares = [r for r in agg_run.rows if r["stage"] == "prepare"]
+    decodes = [r for r in agg_run.rows if r["stage"] == "decode"]
+    assert len(prepares) == 3 and len(decodes) == 3
+    # prepare runs on the decode worker pool, not the device loop thread
+    for p in prepares:
+        assert p["thread_name"].startswith("decode-")
+        assert p["video"] and p["worker"] and p["attempt"] == 1
+    # >1 worker => prepares actually spread across threads
+    assert len({p["thread"] for p in prepares}) > 1
+    # each decode span nests under its video's prepare, on the same thread
+    for d in decodes:
+        parent = by_id[d["parent"]]
+        assert parent["stage"] == "prepare"
+        assert parent["video"] == d["video"]
+        assert parent["thread"] == d["thread"]
+        assert parent["t0"] <= d["t0"] and d["t1"] <= parent["t1"] + 0.05
+    # dispatch/fetch run on the device loop thread with the group size
+    # (3 videos / --video_batch 2 => one full group + a remainder of 1)
+    grouped = [
+        r for r in agg_run.rows
+        if r["stage"] in ("dispatch", "fetch") and r.get("group_size")
+    ]
+    assert {r["group_size"] for r in grouped} == {1, 2}
+    assert all(r["thread_name"] == "MainThread" for r in grouped)
+
+
+def test_summary_json_gains_telemetry_block(agg_run):
+    tele = agg_run.summary["telemetry"]
+    assert tele["counters"]["videos_done"] == 3
+    assert tele["counters"]["frames_decoded"] == 3 * 8
+    # stage totals (the old StageTimer aggregate) now always land here
+    assert tele["stages"]["prepare"]["calls"] == 3
+    assert tele["stages"]["sink"]["calls"] == 3
+    assert tele["stages"]["decode"]["seconds"] > 0
+    assert tele["throughput"]["videos_per_s"] > 0
+    assert tele["throughput"]["decode_fps"] > 0
+    assert tele["overlap"]["spans"] >= 6
+    assert tele["span_files"] and all(f.startswith("spans-") for f in tele["span_files"])
+    # and the one-line digest prints throughput
+    line = faults.format_summary(agg_run.summary)
+    assert "videos/s" in line and "decode fps" in line
+
+
+def test_metrics_snapshot_file_on_disk(agg_run):
+    paths = glob.glob(os.path.join(agg_run.cfg.output_path, "_telemetry", "metrics-*.json"))
+    assert len(paths) == 1
+    with open(paths[0], "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    assert snap["counters"]["videos_done"] == 3
+    hist = snap["histograms"]["stage_s.prepare"]
+    assert hist["count"] == 3 and sum(hist["buckets"]) == 3
+    assert len(hist["buckets"]) == len(hist["bounds"]) + 1
+
+
+# --- consumers: export / report CLI ------------------------------------------
+
+
+def test_export_cli_writes_valid_chrome_trace(agg_run, tmp_path, capsys):
+    out = tmp_path / "trace.json"
+    assert tele_main(["export", agg_run.cfg.output_path, "-o", str(out)]) == 0
+    assert "perfetto" in capsys.readouterr().err
+    with open(out, "r", encoding="utf-8") as f:
+        trace = json.load(f)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == len(agg_run.rows)
+    assert ms and all(m["name"] == "thread_name" for m in ms)
+    last = -1
+    for e in xs:
+        assert isinstance(e["ts"], int) and e["ts"] >= 0
+        assert isinstance(e["dur"], int) and e["dur"] >= 0
+        assert e["name"] in tm.STAGES
+        assert e["ts"] >= last  # monotonic ordering
+        last = e["ts"]
+
+
+def test_report_cli_prints_overlap(agg_run, capsys):
+    assert tele_main(["report", agg_run.cfg.output_path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["spans"] == len(
+        [r for r in agg_run.rows if r["stage"] in tm.HOST_STAGES | tm.DEVICE_STAGES]
+    )
+    assert rep["wall_s"] > 0
+
+
+def test_cli_no_spans_is_usage_error(tmp_path, capsys):
+    assert tele_main(["report", str(tmp_path)]) == 2
+    assert "no spans" in capsys.readouterr().err
+
+
+# --- overlap math on synthetic fixtures --------------------------------------
+
+
+def _row(stage, t0, t1, pid=1):
+    return {"stage": stage, "t0": t0, "t1": t1, "pid": pid}
+
+
+def test_overlap_report_pinned_values():
+    rep = tm.overlap_report([
+        _row("prepare", 0.0, 10.0),
+        _row("dispatch", 5.0, 15.0),
+    ])
+    assert rep["wall_s"] == pytest.approx(15.0)
+    assert rep["host_busy_s"] == pytest.approx(10.0)
+    assert rep["device_busy_s"] == pytest.approx(10.0)
+    assert rep["overlap_s"] == pytest.approx(5.0)
+    assert rep["overlap_efficiency"] == pytest.approx(5.0 / 15.0)
+    assert rep["overlap_of_device"] == pytest.approx(0.5)
+    assert rep["spans"] == 2
+
+
+def test_overlap_report_merges_intervals_before_intersecting():
+    # two abutting host spans + an overlapping third must not double count
+    rep = tm.overlap_report([
+        _row("decode", 0.0, 2.0),
+        _row("decode", 2.0, 4.0),
+        _row("prepare", 1.0, 3.0),
+        _row("fetch", 1.0, 5.0),
+    ])
+    assert rep["host_busy_s"] == pytest.approx(4.0)
+    assert rep["device_busy_s"] == pytest.approx(4.0)
+    assert rep["overlap_s"] == pytest.approx(3.0)  # [1,4]
+    assert rep["wall_s"] == pytest.approx(5.0)
+
+
+def test_overlap_report_is_per_pid():
+    # monotonic clocks are incomparable across pids: same timestamps in
+    # two pids must not be treated as concurrent
+    rows = [_row("prepare", 0.0, 1.0, pid=1), _row("dispatch", 0.0, 1.0, pid=2)]
+    rep = tm.overlap_report(rows)
+    assert rep["overlap_s"] == 0.0
+    assert rep["wall_s"] == pytest.approx(2.0)  # summed per-pid walls
+
+
+def test_overlap_report_ignores_junk_rows():
+    rep = tm.overlap_report([
+        _row("prepare", 0.0, 1.0),
+        _row("extract", 0.0, 50.0),        # serial stage: in neither set
+        {"stage": "fetch", "t0": 3.0, "t1": 1.0, "pid": 1},  # t1 < t0
+        {"stage": "fetch", "t0": None, "t1": 2.0, "pid": 1},
+    ])
+    assert rep["spans"] == 1 and rep["host_busy_s"] == pytest.approx(1.0)
+    assert rep["device_busy_s"] == 0.0
+
+
+def test_chrome_trace_from_synthetic_rows():
+    rows = [
+        {"span": "r.1", "stage": "prepare", "video": "v", "t0": 10.0, "t1": 10.5,
+         "pid": 1, "thread": 7, "thread_name": "decode-cpu_0"},
+        {"span": "r.2", "stage": "dispatch", "video": "v", "t0": 10.25, "t1": 10.75,
+         "pid": 1, "thread": 8, "thread_name": "MainThread"},
+    ]
+    trace = tm.spans_to_chrome_trace(rows)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert [e["ts"] for e in xs] == [0, 250000]
+    assert [e["dur"] for e in xs] == [500000, 500000]
+    assert xs[0]["args"]["video"] == "v" and xs[0]["args"]["span"] == "r.1"
+    names = {e["args"]["name"] for e in trace["traceEvents"] if e["ph"] == "M"}
+    assert names == {"decode-cpu_0", "MainThread"}
+
+
+# --- engine units ------------------------------------------------------------
+
+
+def test_span_exception_stamps_innermost_span_id():
+    tele = tm.Telemetry(enabled=True)
+    with pytest.raises(RuntimeError) as ei:
+        with tele.span("prepare", video="v"):
+            with tele.span("decode", video="v"):
+                raise RuntimeError("boom")
+    rows = tele.spans()
+    decode = next(r for r in rows if r["stage"] == "decode")
+    assert ei.value.telemetry_span == decode["span"]
+    # both spans still closed, aggregate timer fed
+    assert tele.timer.counts["prepare"] == 1 and tele.timer.counts["decode"] == 1
+    tele.close()
+
+
+def test_disabled_mode_is_bare_stage_timer():
+    tele = tm.Telemetry(enabled=False)
+    with tele.span("prepare") as row:
+        assert row is None
+    assert tele.timer.counts["prepare"] == 1
+    assert tele.spans() == []
+    assert tele.begin("decode") is None
+    tm.end(None)  # module hook tolerates the disabled token
+    tele.close()
+
+
+def test_begin_end_token_and_memory_retention():
+    tele = tm.Telemetry(enabled=True)
+    tok = tele.begin("decode", video="v", worker="cpu:0")
+    assert tok is not None and tok.span_id.endswith(".1")
+    tok.finish(frames=8)
+    tok.finish()  # idempotent
+    rows = tele.spans()
+    assert len(rows) == 1 and rows[0]["frames"] == 8
+    assert rows[0]["worker"] == "cpu:0"
+    tele.close()
+
+
+def test_module_hooks_route_to_current_telemetry():
+    tele = tm.Telemetry(enabled=True)
+    tm.set_current(tele)
+    tm.frame_decoded(5)
+    tm.note_bucket((64, 64))
+    tm.note_bucket((64, 64))
+    tm.note_bucket((128, 64))
+    tok = tm.begin("decode", video="v")
+    tm.end(tok)
+    assert tele.metrics.counter("frames_decoded") == 5
+    assert tele.buckets_seen() == 2
+    assert [r["stage"] for r in tele.spans()] == ["decode"]
+    tm.set_current(None)
+    tm.frame_decoded(1)  # no current: must not raise
+    tele.close()
+
+
+def test_payload_nbytes_nested():
+    a = np.zeros((4, 3), dtype=np.float32)
+    assert tm.payload_nbytes(a) == 48
+    assert tm.payload_nbytes({"x": a, "y": [a, a]}) == 144
+    assert tm.payload_nbytes(("s", 3, None)) == 0
+
+
+def test_heartbeat_line_format():
+    tele = tm.Telemetry(enabled=True, total_videos=10)
+    tele.metrics.inc("videos_done", 4)
+    tele.metrics.inc("frames_decoded", 100)
+    line = tele.heartbeat_line()
+    assert line.startswith("telemetry: 4/10 videos,")
+    assert "videos/s" in line and "decode fps" in line and "eta" in line
+    tele.close()
+
+
+def test_read_spans_skips_torn_trailing_line(tmp_path):
+    p = tmp_path / "spans-x.jsonl"
+    p.write_text('{"span": "r.1", "stage": "sink"}\n{"span": "r.2", "sta')
+    rows = tm.read_spans(str(p))
+    assert len(rows) == 1 and rows[0]["span"] == "r.1"
+
+
+def test_merge_metrics_files(tmp_path):
+    tdir = tmp_path / "_telemetry"
+    tdir.mkdir()
+    hist = {"count": 2, "sum": 0.5, "min": 0.1, "max": 0.4,
+            "bounds": list(tm.HIST_BOUNDS), "buckets": [0] * (len(tm.HIST_BOUNDS) + 1)}
+    for i, (done, gauge) in enumerate([(2, 3), (1, 5)]):
+        (tdir / f"metrics-{i}.json").write_text(json.dumps({
+            "t_start": 100.0 + i, "t_snapshot": 110.0 + i,
+            "counters": {"videos_done": done, "frames_decoded": done * 8},
+            "gauges": {"queue_depth.pending": gauge},
+            "histograms": {"stage_s.decode": hist},
+            "buckets_seen": i + 1,
+        }))
+    (tdir / "metrics-torn.json").write_text("{nope")  # crashed process
+    block = tm.merge_metrics_files(str(tmp_path))
+    assert block["counters"]["videos_done"] == 3           # counters sum
+    assert block["gauges"]["queue_depth.pending"] == 5     # gauges max
+    merged = block["histograms"]["stage_s.decode"]
+    assert merged["count"] == 4 and merged["sum"] == pytest.approx(1.0)
+    assert block["buckets_seen"] == 2
+    # wall spans min(t_start)..max(t_snapshot); decode fps uses stage sum
+    assert block["throughput"]["wall_s"] == pytest.approx(11.0)
+    assert block["throughput"]["videos_per_s"] == pytest.approx(3 / 11.0)
+    assert block["throughput"]["decode_fps"] == pytest.approx(24 / 1.0)
+    assert tm.merge_metrics_files(str(tmp_path / "nowhere")) is None
+
+
+def test_flush_concurrent_with_recording():
+    tele = tm.Telemetry(enabled=True)
+    stop = threading.Event()
+
+    def record():
+        while not stop.is_set():
+            with tele.span("sink", video="v"):
+                pass
+
+    threads = [threading.Thread(target=record) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(20):
+        tele.flush()
+    stop.set()
+    for t in threads:
+        t.join()
+    rows = tele.spans()
+    assert len(rows) == tele.timer.counts["sink"]
+    tele.close()
+
+
+# --- recompile watch ---------------------------------------------------------
+
+
+class _FakeManifest:
+    def __init__(self):
+        self.records = []
+
+    def record(self, key, status, **fields):
+        self.records.append((key, status, fields))
+
+
+def test_runtime_compile_limits_from_committed_budget():
+    limits = tm.runtime_compile_limits()
+    assert limits and all(v >= 1 for v in limits.values())
+    # the device-preprocess family the watch exists for is budgeted
+    assert "encode_raw" in limits
+
+
+def test_recompile_watch_warns_once_above_per_bucket_allowance():
+    tele = tm.Telemetry(enabled=True)
+    man = _FakeManifest()
+    watch = tm.RecompileWatch(tele, man)  # not attached: unit-test on_compile
+    watch.limits = {"encode_raw": 2}
+    for _ in range(2):
+        watch.on_compile("encode_raw")
+    assert man.records == []  # within the ceiling
+    watch.on_compile("encode_raw")
+    assert len(man.records) == 1
+    key, status, fields = man.records[0]
+    assert status == "warning" and fields["stage"] == "compile"
+    assert "encode_raw" in fields["message"] and "allowance is 2" in fields["message"]
+    watch.on_compile("encode_raw")  # one warning per fn name, ever
+    assert len(man.records) == 1
+    # every build became a counter increment + a zero-duration span
+    assert tele.metrics.counter("compiles") == 4
+    compiles = [r for r in tele.spans() if r["stage"] == "compile"]
+    assert [c["n"] for c in compiles] == [1, 2, 3, 4]
+    assert all(c["fn"] == "encode_raw" for c in compiles)
+    tele.close()
+
+
+def test_recompile_watch_allowance_scales_with_buckets():
+    tele = tm.Telemetry(enabled=True)
+    tele.note_bucket((64, 64))
+    tele.note_bucket((128, 128))
+    man = _FakeManifest()
+    watch = tm.RecompileWatch(tele, man)
+    watch.limits = {"encode_raw": 2}
+    for _ in range(4):  # 2/bucket x 2 buckets: still legitimate
+        watch.on_compile("encode_raw")
+    assert man.records == []
+    watch.on_compile("encode_raw")
+    assert len(man.records) == 1 and "x 2" in man.records[0][2]["message"]
+    # unbudgeted names never warn
+    for _ in range(50):
+        watch.on_compile("totally_novel_fn")
+    assert len(man.records) == 1
+    tele.close()
+
+
+# --- config + end-to-end off switch ------------------------------------------
+
+
+def test_config_flags_validate():
+    sanity_check(ExtractionConfig(telemetry="off", heartbeat_s=5.0))
+    with pytest.raises(ValueError, match="telemetry"):
+        sanity_check(ExtractionConfig(telemetry="sometimes"))
+    with pytest.raises(ValueError, match="heartbeat_s"):
+        sanity_check(ExtractionConfig(heartbeat_s=-1.0))
+
+
+def test_telemetry_off_run_keeps_timer_writes_nothing(toy_videos, tmp_path):
+    cfg = _cfg(toy_videos[:2], tmp_path, telemetry="off", decode_workers=2)
+    ex = ToyExtractor(cfg)
+    ex()
+    ex.telemetry.close()
+    assert not os.path.isdir(os.path.join(cfg.output_path, "_telemetry"))
+    # the aggregate timer (--profile_dir's data source) still accumulates
+    assert ex.timer.counts["prepare"] == 2 and ex.timer.counts["sink"] == 2
+    s = faults.finalize_run(cfg.output_path)
+    assert s["done"] == 2 and "telemetry" not in s
+    assert "videos/s" not in faults.format_summary(s)
+
+
+def test_failure_record_links_failing_stage_span(toy_videos, tmp_path):
+    # permanent prepare fault on video 2: its manifest record must carry
+    # the span id of the failing interval, resolvable in the span file
+    cfg = _cfg(
+        toy_videos[:2], tmp_path, retries=0, fault_inject=["prepare:corrupt:2"]
+    )
+    ex = ToyExtractor(cfg)
+    ex()
+    ex.telemetry.close()
+    s = faults.finalize_run(cfg.output_path)
+    assert s["done"] == 1 and s["failed"] == 1
+    rec = s["videos"][toy_videos[1]]
+    assert rec["status"] == "failed" and rec.get("span")
+    files = glob.glob(os.path.join(cfg.output_path, "_telemetry", "spans-*.jsonl"))
+    rows = [r for f in files for r in tm.read_spans(f)]
+    failing = next(r for r in rows if r["span"] == rec["span"])
+    assert failing["stage"] == "prepare"
+    assert failing["video"] == toy_videos[1]

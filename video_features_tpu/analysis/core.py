@@ -92,6 +92,9 @@ HOT_MODULE_PATTERNS = (
     "ops/*.py",
     "ops/*/*.py",
     "models/*/model.py",
+    # telemetry records inside the per-video loops; a device sync or
+    # unguarded global here would tax every video (ISSUE 6)
+    "runtime/telemetry.py",
 )
 
 # Thread-spawning roots for the thread-safety reachability walk: the
@@ -100,6 +103,7 @@ THREAD_ROOT_PATTERNS = (
     "parallel/scheduler.py",
     "extract/base.py",
     "runtime/faults.py",
+    "runtime/telemetry.py",
     "io/sink.py",
     "native/__init__.py",
     "utils/profiling.py",

@@ -58,6 +58,10 @@ def main(argv=None) -> None:
         # completed (docs/robustness.md). Gated on this run actually
         # recording (print-mode ad-hoc runs have no manifest dir).
         summary = None
+        # final telemetry drain BEFORE the merge so the summary's
+        # metrics/throughput block (and the digest line below) reflect
+        # the whole run — including a run the scheduler aborted
+        extractor.telemetry.close()
         if getattr(extractor.manifest, "path", None) is not None:
             from video_features_tpu.runtime.faults import finalize_run, format_summary
 

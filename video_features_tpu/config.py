@@ -248,6 +248,15 @@ class ExtractionConfig:
     # so the retry/fallback/manifest paths are exercised by fast CPU
     # tests (tests/test_faults.py).
     fault_inject: Optional[List[str]] = None
+    # Structured telemetry (runtime/telemetry.py): 'on' records per-stage
+    # spans to <output>/_telemetry/spans-*.jsonl plus a metrics block in
+    # summary.json; 'off' degrades to the bare StageTimer aggregate (the
+    # pre-telemetry behaviour, and the baseline the telemetry_overhead
+    # bench part compares against).
+    telemetry: str = "on"
+    # Seconds between heartbeat progress lines on stderr (videos/sec,
+    # decode fps, ETA) during save runs; 0 disables the heartbeat.
+    heartbeat_s: float = 30.0
     # 3D-conv lowering for the 3D-conv families, i3d + r21d
     # (common/layers.py::Conv3DCompat):
     #   'auto'       — honor the VFT_CONV3D_IMPL env var, else direct;
@@ -395,6 +404,10 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         from video_features_tpu.runtime.faults import parse_fault_specs
 
         parse_fault_specs(cfg.fault_inject)  # raises naming the bad spec
+    if cfg.telemetry not in ("on", "off"):
+        raise ValueError(f"telemetry must be 'on' or 'off', got {cfg.telemetry!r}")
+    if cfg.heartbeat_s < 0:
+        raise ValueError(f"heartbeat_s must be >= 0, got {cfg.heartbeat_s}")
     if cfg.mesh_context and cfg.attn != "fused":
         raise ValueError(
             "--mesh_context injects the ring-attention core; it cannot "
@@ -548,6 +561,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "stall at STAGE (decode|prepare|dispatch|sink) "
                         "every N calls; KIND in error|corrupt|hang|oom|"
                         "compile|kill; repeatable")
+    p.add_argument("--telemetry", choices=["on", "off"], default="on",
+                   help="structured telemetry: per-stage spans to "
+                        "<output>/_telemetry/spans-*.jsonl, metrics + "
+                        "overlap-efficiency block in summary.json, and a "
+                        "heartbeat progress line (default on)")
+    p.add_argument("--heartbeat_s", type=float, default=30.0,
+                   help="seconds between telemetry heartbeat lines "
+                        "(videos/sec, decode fps, ETA) on stderr; 0 "
+                        "disables")
     p.add_argument("--mesh_context", action="store_true",
                    help="context parallelism under --sharding mesh: shard "
                         "the transformer token axis over the mesh and run "

@@ -30,8 +30,10 @@ from video_features_tpu.config import as_config
 from video_features_tpu.io.paths import form_list_from_user_input, video_path_of
 from video_features_tpu.io.sink import action_on_extraction, expected_output_files
 from video_features_tpu.runtime import faults
+from video_features_tpu.runtime import telemetry as telemetry_mod
 from video_features_tpu.runtime.faults import NULL_MANIFEST, RunManifest
-from video_features_tpu.utils.profiling import StageTimer, device_trace
+from video_features_tpu.runtime.telemetry import Telemetry
+from video_features_tpu.utils.profiling import device_trace
 
 
 class BaseExtractor:
@@ -65,7 +67,6 @@ class BaseExtractor:
         self.tmp_path = os.path.join(self.config.tmp_path, self.feature_type)
         self._device_state: Dict[Any, Any] = {}
         self._build_lock = threading.Lock()
-        self.timer = StageTimer()
         # --- fault tolerance (runtime/faults.py; docs/robustness.md) ---
         # The manifest roots at config.output_path (NOT the feature-
         # suffixed dir): one <output>/_manifest covers a multi-feature
@@ -79,6 +80,36 @@ class BaseExtractor:
         self.manifest = (
             RunManifest(self.config.output_path) if wants_manifest else NULL_MANIFEST
         )
+        # --- structured telemetry (runtime/telemetry.py; docs/observability.md)
+        # Spans/metrics stream next to the manifest (<output>/_telemetry)
+        # on save runs; external/print runs keep spans in memory so bench
+        # passes can still compute overlap efficiency. '--telemetry off'
+        # degrades span() to the bare StageTimer aggregate. self.timer
+        # stays the span-backed StageTimer view, so --profile_dir's
+        # summary print and existing tests are unchanged.
+        wants_telemetry = getattr(self.config, "telemetry", "on") != "off"
+        tele_root = self.config.output_path if (wants_manifest and wants_telemetry) else None
+        self.telemetry = Telemetry(
+            output_root=tele_root,
+            enabled=wants_telemetry,
+            heartbeat_s=(
+                float(getattr(self.config, "heartbeat_s", 30.0) or 0.0)
+                if tele_root is not None
+                else 0.0
+            ),
+            total_videos=len(self.path_list),
+        )
+        self.timer = self.telemetry.timer
+        telemetry_mod.set_current(self.telemetry)
+        if (
+            wants_telemetry
+            and tele_root is not None
+            and getattr(self.config, "preprocess", "host") == "device"
+        ):
+            # production recompile watch: jax_log_compiles -> compile
+            # spans + ONE manifest warning per fn family exceeding its
+            # committed per-bucket budget (analysis/compile_budget.json)
+            self.telemetry.arm_recompile_watch(self.manifest)
         faults.install_injector(getattr(self.config, "fault_inject", None))
         from video_features_tpu.io.video import set_decode_timeout
 
@@ -117,7 +148,7 @@ class BaseExtractor:
         if fps and getattr(self.config, "fps_retarget", "nearest") == "reencode":
             from video_features_tpu.io.ffmpeg import reencode_video_with_diff_fps
 
-            with self.timer.stage("reencode"):
+            with self.telemetry.span("reencode", video=str(video_path)):
                 return (
                     reencode_video_with_diff_fps(
                         video_path,
@@ -257,7 +288,7 @@ class BaseExtractor:
 
             if self.config.sharding == "mesh" and _jax.process_index() != 0:
                 return
-            with self.timer.stage("sink"):
+            with self.telemetry.span("sink", video=self._video_key(entry)):
                 warnings = action_on_extraction(
                     feats_dict,
                     video_path_of(entry),
@@ -327,6 +358,7 @@ class BaseExtractor:
         return time.monotonic() - t0 if t0 is not None else None
 
     def _on_success(self, entry, attempt: int, note: Optional[str] = None) -> None:
+        self.telemetry.metrics.inc("videos_done")
         extra = {"note": note} if note else {}
         self.manifest.record(
             self._video_key(entry),
@@ -360,6 +392,13 @@ class BaseExtractor:
         error_class = faults.classify_error(exc) if exc is not None else "permanent"
         video = self._video_key(entry)
         retries = int(getattr(self.config, "retries", 0) or 0)
+        # the failing stage's span id (stamped by Telemetry.span on the
+        # way out, innermost wins) links this manifest record to its
+        # interval in _telemetry/spans-*.jsonl
+        span_extra = {}
+        span_id = getattr(exc, "telemetry_span", None)
+        if span_id is not None:
+            span_extra["span"] = span_id
         if (
             requeue is not None
             and faults.is_retryable(error_class)
@@ -368,6 +407,7 @@ class BaseExtractor:
             delay = faults.backoff_delay(
                 attempt, float(getattr(self.config, "retry_backoff", 0.0)), video
             )
+            self.telemetry.metrics.inc("retries")
             self.manifest.record(
                 video,
                 "retry",
@@ -377,6 +417,7 @@ class BaseExtractor:
                 message=str(exc),
                 attempts=attempt,
                 wall_s=self._wall(entry),
+                **span_extra,
             )
             print(
                 f"Transient {stage} failure for {video} (attempt "
@@ -394,6 +435,7 @@ class BaseExtractor:
                 error_type=type(exc).__name__,
                 message=str(exc),
                 attempts=attempt,
+                **span_extra,
             )
             fallback()
             return
@@ -406,6 +448,7 @@ class BaseExtractor:
             message=str(exc) if exc is not None else None,
             attempts=attempt,
             wall_s=self._wall(entry),
+            **span_extra,
         )
         self._report_video_error(entry)
 
@@ -436,9 +479,10 @@ class BaseExtractor:
         )
         self._force_host.on = True
         try:
-            with self.timer.stage("prepare"):
+            with self.telemetry.span("prepare", video=video, attempt=attempt):
                 payload = self.prepare(entry)
-            with self.timer.stage("device"):
+            with self.telemetry.span("dispatch", video=video, attempt=attempt):
+                self.telemetry.count_h2d(payload)
                 feats_dict = self.extract_prepared(device, state, entry, payload)
             self._sink_or_collect(feats_dict, entry, results, pos)
         except KeyboardInterrupt:
@@ -473,6 +517,10 @@ class BaseExtractor:
                 self._run_pipelined(indices, device, state, results)
             else:
                 self._run_serial(indices, device, state, results)
+        # stage totals always land in summary.json via the telemetry
+        # metrics snapshot (finalize_run merges them); the console print
+        # stays opt-in behind --profile_dir
+        self.telemetry.flush()
         if self.config.profile_dir:
             print(self.timer.summary())
         if self.external_call:
@@ -485,6 +533,7 @@ class BaseExtractor:
         (``not_before``) instead of being dropped after one try."""
         from collections import deque
 
+        wid = str(device)
         queue: deque = deque((pos, idx, 1, 0.0) for pos, idx in enumerate(indices))
         while queue:
             pos, idx, attempt, not_before = queue.popleft()
@@ -499,7 +548,10 @@ class BaseExtractor:
                 time.sleep(wait)
             self._mark_start(entry)
             try:
-                with self.timer.stage("extract"):
+                with self.telemetry.span(
+                    "extract", video=self._video_key(entry),
+                    attempt=attempt, worker=wid,
+                ):
                     feats_dict = self.extract(device, state, entry)
                 self._sink_or_collect(feats_dict, entry, results, pos)
             except KeyboardInterrupt:
@@ -544,12 +596,16 @@ class BaseExtractor:
 
         workers = max(1, int(self.config.decode_workers))
         depth = workers + 1  # prepared-and-waiting beyond the one consumed
+        wid = str(device)
 
-        def prep(entry, delay: float = 0.0):
+        def prep(entry, delay: float = 0.0, attempt: int = 1):
             if delay > 0:
                 time.sleep(delay)  # backoff burns a decode worker, not the device loop
             self._mark_start(entry)
-            with self.timer.stage("prepare"):
+            with self.telemetry.span(
+                "prepare", video=self._video_key(entry),
+                attempt=attempt, worker=wid,
+            ):
                 faults.fire("prepare")
                 return self.prepare(entry)
 
@@ -576,7 +632,8 @@ class BaseExtractor:
 
             def do(delay: float) -> None:
                 pending.append(
-                    (pos, idx, attempt + 1, pool.submit(prep, self.path_list[idx], delay))
+                    (pos, idx, attempt + 1,
+                     pool.submit(prep, self.path_list[idx], delay, attempt + 1))
                 )
 
             return do
@@ -601,7 +658,11 @@ class BaseExtractor:
             try:
                 if inject:
                     faults.fire("dispatch")
-                with self.timer.stage("device"):
+                with self.telemetry.span(
+                    "dispatch", video=self._video_key(entry),
+                    attempt=attempt, worker=wid,
+                ):
+                    self.telemetry.count_h2d(payload)
                     feats_dict = self.extract_prepared(device, state, entry, payload)
             except KeyboardInterrupt:
                 raise
@@ -649,7 +710,9 @@ class BaseExtractor:
             if grouped:
                 fused_err = None
                 try:
-                    with self.timer.stage("device"):
+                    with self.telemetry.span(
+                        "fetch", worker=wid, group_size=len(slots),
+                    ):
                         dicts = self.fetch_group(handle)
                 except KeyboardInterrupt:
                     raise
@@ -675,7 +738,10 @@ class BaseExtractor:
                 return
             pos, idx, attempt, entry = slots[0]
             try:
-                with self.timer.stage("device"):
+                with self.telemetry.span(
+                    "fetch", video=self._video_key(entry),
+                    attempt=attempt, worker=wid,
+                ):
                     feats_dict = self.fetch_dispatched(handle)
             except KeyboardInterrupt:
                 raise
@@ -701,7 +767,11 @@ class BaseExtractor:
                 # one device program); the OOM spec's split-then-recover
                 # path is exactly this: fused raise -> solo_fallback
                 faults.fire("dispatch")
-                with self.timer.stage("device"):
+                with self.telemetry.span(
+                    "dispatch", worker=wid, group_size=len(items),
+                ):
+                    for p in payloads:
+                        self.telemetry.count_h2d(p)
                     handle = self.dispatch_group(device, state, entries, payloads)
             except KeyboardInterrupt:
                 raise
@@ -720,7 +790,11 @@ class BaseExtractor:
             if split:
                 try:
                     faults.fire("dispatch")
-                    with self.timer.stage("device"):
+                    with self.telemetry.span(
+                        "dispatch", video=self._video_key(entry),
+                        attempt=attempt, worker=wid,
+                    ):
+                        self.telemetry.count_h2d(payload)
                         inflight.append(
                             (
                                 [(pos, idx, attempt, entry)],
@@ -749,10 +823,22 @@ class BaseExtractor:
 
         def consume_one():
             pos, idx, attempt, fut = pending.popleft()
+            # queue-depth gauges: how full the host->device pipeline is
+            # at each consume (pending prepare futures, buffered group
+            # payloads, in-flight device dispatches)
+            metrics = self.telemetry.metrics
+            metrics.set_gauge("queue_depth.pending", len(pending))
+            metrics.set_gauge("queue_depth.inflight", len(inflight))
+            if agg:
+                metrics.set_gauge(
+                    "queue_depth.group_buffers", sum(len(b) for b in groups.values())
+                )
             entry = self.path_list[idx]
             try:
                 payload = fut.result()
                 key = self.agg_key(payload) if agg else None
+                if key is not None:
+                    self.telemetry.note_bucket(key)
             except KeyboardInterrupt:
                 raise
             except Exception:  # noqa: BLE001 - prepare/decode failed: classify

@@ -44,6 +44,7 @@ import cv2
 import numpy as np
 
 from video_features_tpu.runtime import faults
+from video_features_tpu.runtime import telemetry
 from video_features_tpu.runtime.faults import CorruptVideoError, DecodeTimeout
 
 _DECODER = "auto"  # 'auto' | 'cv2' | 'native'; set once from the config
@@ -92,6 +93,10 @@ class _Reader:
     """
 
     def __init__(self, path: str, decoder: Optional[str] = None) -> None:
+        # one 'decode' span per reader lifetime (open -> close), via the
+        # module-level hook so samplers need no telemetry plumbing; the
+        # token is None when telemetry is absent/disabled
+        self._span = telemetry.begin("decode", video=str(path))
         d = _resolve(decoder)
         self._nat = None
         self._cap = None
@@ -142,9 +147,13 @@ class _Reader:
 
     def retrieve(self) -> Optional[np.ndarray]:
         if self._nat is not None:
-            return self._nat.retrieve()
-        ok, frame = self._cap.retrieve()
-        return cv2.cvtColor(frame, cv2.COLOR_BGR2RGB) if ok else None
+            frame = self._nat.retrieve()
+        else:
+            ok, frame = self._cap.retrieve()
+            frame = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB) if ok else None
+        if frame is not None:
+            telemetry.frame_decoded()
+        return frame
 
     def read(self) -> Optional[np.ndarray]:
         return self.retrieve() if self.grab() else None
@@ -154,6 +163,7 @@ class _Reader:
             self._nat.close()
         elif self._cap is not None:
             self._cap.release()
+        telemetry.end(self._span)
 
     def __enter__(self):
         return self

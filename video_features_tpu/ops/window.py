@@ -12,6 +12,8 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from video_features_tpu.runtime import telemetry
+
 
 def bucket_size(n: int, multiple: int = 8, buckets: Optional[Sequence[int]] = None) -> int:
     """Smallest allowed padded size >= n."""
@@ -47,8 +49,13 @@ def spatial_bucket(
     if buckets:
         for bh, bw in sorted(buckets, key=lambda b: b[0] * b[1]):
             if h <= bh and w <= bw:
+                telemetry.note_bucket((int(bh), int(bw)))
                 return int(bh), int(bw)
-    return bucket_size(h, multiple), bucket_size(w, multiple)
+    out = bucket_size(h, multiple), bucket_size(w, multiple)
+    # distinct buckets scale the recompile watch's runtime allowance
+    # (runtime/telemetry.py): compiles may grow O(buckets), never O(videos)
+    telemetry.note_bucket(out)
+    return out
 
 
 def flow_output_bucket(
@@ -70,7 +77,9 @@ def flow_output_bucket(
     (input bucket, output bucket) pair."""
     tgt_h = max(int(math.ceil(oh / div) * div), min_size)
     tgt_w = max(int(math.ceil(ow / div) * div), min_size)
-    return bucket_size(tgt_h, multiple), bucket_size(tgt_w, multiple)
+    out = bucket_size(tgt_h, multiple), bucket_size(tgt_w, multiple)
+    telemetry.note_bucket(("flow",) + out)
+    return out
 
 
 def pad_hw(x: np.ndarray, to_h: int, to_w: int) -> np.ndarray:

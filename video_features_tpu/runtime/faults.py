@@ -412,7 +412,10 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
         terminal = status in ("done", "failed")
         if terminal or cur["status"] not in ("done", "failed"):
             cur["status"] = status
-            for field in ("stage", "error_class", "error_type", "message", "wall_s"):
+            # 'span' links a failure to its interval in
+            # _telemetry/spans-*.jsonl (runtime/telemetry.py)
+            for field in ("stage", "error_class", "error_type", "message",
+                          "wall_s", "span"):
                 if field in r:
                     cur[field] = r[field]
                 elif field in cur and terminal:
@@ -441,6 +444,18 @@ def finalize_run(output_root: str) -> Optional[Dict[str, Any]]:
     summary = merge_manifest(output_root)
     if summary is None:
         return None
+    # telemetry block: merged metrics snapshots (stage totals, counters,
+    # throughput) + the overlap-efficiency report over the span files.
+    # A telemetry bug must never lose the run record, so failures land
+    # as a string instead of raising.
+    try:
+        from video_features_tpu.runtime import telemetry as _telemetry
+
+        tblock = _telemetry.collect(output_root)
+        if tblock:
+            summary["telemetry"] = tblock
+    except Exception as e:  # noqa: BLE001 - keep the manifest writable
+        summary["telemetry_error"] = repr(e)
     path = os.path.join(manifest_dir(output_root), SUMMARY_BASENAME)
     tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
@@ -460,6 +475,10 @@ def format_summary(summary: Dict[str, Any]) -> str:
         parts.append(f"{len(summary['warnings'])} warning(s)")
     if summary["worker_deaths"]:
         parts.append(f"{len(summary['worker_deaths'])} worker death(s)")
+    tput = summary.get("telemetry", {}).get("throughput")
+    if tput:
+        parts.append(f"{tput.get('videos_per_s', 0.0):.2f} videos/s")
+        parts.append(f"{tput.get('decode_fps', 0.0):.0f} decode fps")
     line = ", ".join(parts)
     failed = [k for k, v in summary["videos"].items() if v["status"] == "failed"]
     if failed:

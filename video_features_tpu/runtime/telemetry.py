@@ -1,0 +1,845 @@
+"""Structured telemetry for the extraction hot path: spans, metrics, heartbeat.
+
+The reference pipeline's only observability was a tqdm bar (SURVEY.md
+§5) and ours was an aggregate :class:`~video_features_tpu.utils.profiling.StageTimer`
+printed behind ``--profile_dir``. This module replaces both with the
+three primitives every ROADMAP item ahead of us needs:
+
+* **Spans** — one record per (video, stage) interval with monotonic
+  start/end, thread + worker id, attempt, and arbitrary attributes,
+  buffered in memory and drained to ``<output>/_telemetry/spans-*.jsonl``
+  by a single shared daemon thread so the hot loops never block on I/O.
+  Stage names are the pipeline's own: ``decode`` / ``reencode`` /
+  ``prepare`` / ``h2d`` / ``dispatch`` / ``fetch`` / ``sink`` /
+  ``compile`` / ``extract`` (the serial loop's fused stage).
+* **Metrics registry** — process-wide counters (videos done, frames
+  decoded, H2D bytes, retries, compiles), gauges (pipelined queue
+  depths), and log-bucketed stage-latency histograms, snapshotted
+  atomically to ``_telemetry/metrics-*.json`` on every drain so a
+  crashed run still reports throughput.
+* **Heartbeat** — a periodic one-line progress print (videos/sec,
+  decode fps, ETA) replacing silence on long runs.
+
+Two consumers live in :mod:`video_features_tpu.telemetry` (the package):
+``python -m video_features_tpu.telemetry export`` emits Chrome-trace /
+Perfetto JSON from a spans file, and ``report`` prints the
+overlap-efficiency summary computed by :func:`overlap_report` here — the
+fraction of wall time where host decode/prepare overlaps device
+dispatch/fetch, the measurement baseline for the async-ingest ROADMAP
+item.
+
+Like :mod:`video_features_tpu.runtime.faults` this module imports no
+jax at module scope: telemetry records host-side wall time only and must
+introduce no device syncs (graftcheck GC10x covers this file). All
+module-level mutable state is lock-guarded (GC301); the drain thread is
+shared across Telemetry instances so a process that builds many
+extractors (tests, service mode later) holds one background thread, not
+one per run.
+"""
+
+from __future__ import annotations
+
+import bisect
+import glob
+import io
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from video_features_tpu.utils.profiling import StageTimer
+
+STAGES = (
+    "decode", "reencode", "prepare", "h2d",
+    "dispatch", "fetch", "sink", "compile", "extract",
+)
+
+# Host-side ingest stages vs device dispatch/fetch stages, for the
+# overlap-efficiency report. ``extract`` (the serial loop's fused
+# prepare+device stage) is deliberately in neither set: the serial loop
+# has no overlap story to measure.
+HOST_STAGES = frozenset({"decode", "reencode", "prepare"})
+DEVICE_STAGES = frozenset({"h2d", "dispatch", "fetch"})
+
+# Log-ish latency buckets (seconds) for stage histograms: fine-grained
+# where per-video stages actually land (1ms..1s), coarse above.
+HIST_BOUNDS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+_DRAIN_INTERVAL_S = 0.5
+# Bounded retention when there is no file sink (external_call / bench):
+# enough for overlap math over a bench pass, small enough to never
+# matter for memory.
+_MEM_RETAIN_SPANS = 100_000
+
+# -- process-global state (all writes under _STATE_LOCK; GC301) ---------
+_STATE_LOCK = threading.Lock()
+_CURRENT: Optional["Telemetry"] = None
+_DRAINER: Optional[threading.Thread] = None
+_TARGETS: "weakref.WeakSet[Telemetry]" = weakref.WeakSet()
+# the armed RecompileWatch is process-global latest-wins (like
+# faults.install_injector): jax_log_compiles + the pxla log handler are
+# process state, so exactly one watch may be attached at a time
+_WATCH: Optional["RecompileWatch"] = None
+
+
+def set_current(tele: Optional["Telemetry"]) -> None:
+    """Install ``tele`` as the process-current telemetry, the sink for
+    module-level hooks (:func:`frame_decoded`, :func:`begin`/:func:`end`,
+    :func:`note_bucket`) used by code that has no extractor reference
+    (io/ decode, ops/ bucketing). Latest-wins, like
+    ``faults.install_injector``."""
+    global _CURRENT
+    with _STATE_LOCK:
+        _CURRENT = tele
+
+
+def current() -> Optional["Telemetry"]:
+    return _CURRENT
+
+
+def frame_decoded(n: int = 1) -> None:
+    """Count decoded frames into the current telemetry (io/video.py hook)."""
+    t = _CURRENT
+    if t is not None and t.enabled:
+        t.metrics.inc("frames_decoded", n)
+
+
+def note_bucket(key: Any) -> None:
+    """Record a distinct spatial/output bucket (ops/window.py hook); the
+    recompile watch scales its runtime ceilings by the bucket count."""
+    t = _CURRENT
+    if t is not None and t.enabled:
+        t.note_bucket(key)
+
+
+def begin(stage: str, video: Optional[str] = None, **extra: Any) -> Optional["SpanToken"]:
+    """Open a span on the current telemetry; returns None when telemetry
+    is absent/disabled so callers can pass the token straight to
+    :func:`end` unconditionally. For code (io/ readers) whose interval
+    does not nest lexically."""
+    t = _CURRENT
+    if t is None or not t.enabled:
+        return None
+    return t.begin(stage, video=video, **extra)
+
+
+def end(token: Optional["SpanToken"]) -> None:
+    if token is not None:
+        token.finish()
+
+
+def _ensure_drainer() -> None:
+    global _DRAINER
+    with _STATE_LOCK:
+        if _DRAINER is not None and _DRAINER.is_alive():
+            return
+        t = threading.Thread(target=_drain_loop, name="telemetry-drain", daemon=True)
+        _DRAINER = t
+    t.start()
+
+
+def _drain_loop() -> None:
+    while True:
+        time.sleep(_DRAIN_INTERVAL_S)
+        for tele in list(_TARGETS):
+            try:
+                tele.flush()
+                tele.maybe_heartbeat()
+            except Exception:  # noqa: BLE001 - observability must never kill the run
+                pass
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / histograms with a dict snapshot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        # name -> [count, sum, min, max, bucket_counts(len(HIST_BOUNDS)+1)]
+        self._hists: Dict[str, list] = {}
+        self.t_start = time.time()
+
+    def inc(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = [0, 0.0, value, value, [0] * (len(HIST_BOUNDS) + 1)]
+                self._hists[name] = h
+            h[0] += 1
+            h[1] += value
+            h[2] = min(h[2], value)
+            h[3] = max(h[3], value)
+            h[4][bisect.bisect_left(HIST_BOUNDS, value)] += 1
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "t_start": self.t_start,
+                "t_snapshot": time.time(),
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    name: {
+                        "count": h[0], "sum": h[1], "min": h[2], "max": h[3],
+                        "bounds": list(HIST_BOUNDS), "buckets": list(h[4]),
+                    }
+                    for name, h in self._hists.items()
+                },
+            }
+
+
+class SpanToken:
+    """Handle for a begin/end span (non-lexical intervals: io/ readers)."""
+
+    __slots__ = ("_tele", "_row", "_t0", "_done")
+
+    def __init__(self, tele: "Telemetry", row: Dict[str, Any], t0: float) -> None:
+        self._tele = tele
+        self._row = row
+        self._t0 = t0
+        self._done = False
+
+    @property
+    def span_id(self) -> str:
+        return self._row["span"]
+
+    def finish(self, **extra: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        if extra:
+            self._row.update(extra)
+        self._tele._finish_row(self._row, self._t0)
+
+
+class Telemetry:
+    """Per-run span recorder + metrics registry + heartbeat.
+
+    ``enabled=False`` degrades :meth:`span` to bare StageTimer timing —
+    the exact pre-telemetry behaviour, used as the baseline by the
+    ``telemetry_overhead`` bench part. With no ``output_root`` (external
+    calls, bench passes) spans are retained in a bounded in-memory deque
+    instead of a file so overlap math still works.
+    """
+
+    def __init__(
+        self,
+        output_root: Optional[str] = None,
+        enabled: bool = True,
+        heartbeat_s: float = 0.0,
+        total_videos: Optional[int] = None,
+        run_id: Optional[str] = None,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.output_root = output_root
+        self.heartbeat_s = float(heartbeat_s or 0.0)
+        self.total_videos = total_videos
+        self.run_id = run_id or f"{int(time.time()):x}-{os.getpid():x}"
+        self.timer = StageTimer()  # span-backed aggregate view
+        self.metrics = MetricsRegistry()
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._seq = 0
+        self._rows: deque = deque()
+        self._mem: deque = deque(maxlen=_MEM_RETAIN_SPANS)
+        self._buckets: set = set()
+        self._local = threading.local()
+        self._path: Optional[str] = None
+        self._metrics_path: Optional[str] = None
+        self._file: Optional[io.TextIOBase] = None
+        self._next_heartbeat = (
+            time.monotonic() + self.heartbeat_s if self.heartbeat_s > 0 else None
+        )
+        self._closed = False
+        self._watch: Optional["RecompileWatch"] = None
+        if self.enabled and output_root:
+            tdir = os.path.join(output_root, "_telemetry")
+            os.makedirs(tdir, exist_ok=True)
+            base = f"{os.getpid()}-{self.run_id}"
+            self._path = os.path.join(tdir, f"spans-{base}.jsonl")
+            self._metrics_path = os.path.join(tdir, f"metrics-{base}.json")
+        if self.enabled:
+            _TARGETS.add(self)
+            _ensure_drainer()
+
+    # -- spans ----------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = []
+            self._local.stack = st
+        return st
+
+    def _new_row(self, stage: str, video: Optional[str], extra: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        th = threading.current_thread()
+        stack = self._stack()
+        row: Dict[str, Any] = {
+            "span": f"{self.run_id}.{seq}",
+            "seq": seq,
+            "parent": stack[-1]["span"] if stack else None,
+            "stage": stage,
+            "video": video,
+            "pid": os.getpid(),
+            "run": self.run_id,
+            "thread": th.ident or 0,
+            "thread_name": th.name,
+        }
+        if extra:
+            row.update(extra)
+        return row
+
+    def _finish_row(self, row: Dict[str, Any], t0: float) -> None:
+        t1 = time.monotonic()
+        row["t0"] = t0
+        row["t1"] = t1
+        dt = t1 - t0
+        stage = row["stage"]
+        with self.timer._lock:
+            self.timer.seconds[stage] += dt
+            self.timer.counts[stage] += 1
+        self.metrics.observe(f"stage_s.{stage}", dt)
+        with self._lock:
+            self._rows.append(row)
+            if self._path is None:
+                self._mem.append(row)
+
+    @contextmanager
+    def span(
+        self, stage: str, video: Optional[str] = None, **extra: Any
+    ) -> Iterator[Optional[Dict[str, Any]]]:
+        """Time a stage. Disabled mode keeps the StageTimer aggregate
+        (pre-telemetry behaviour) and yields None; enabled mode yields
+        the mutable row (callers may add attributes) and, on an escaping
+        exception, stamps the span id onto the exception as
+        ``telemetry_span`` (innermost span wins) so manifest failure
+        records link to the timeline."""
+        if not self.enabled:
+            with self.timer.stage(stage):
+                yield None
+            return
+        row = self._new_row(stage, video, extra)
+        stack = self._stack()
+        stack.append(row)
+        t0 = time.monotonic()
+        try:
+            yield row
+        except BaseException as exc:
+            if not hasattr(exc, "telemetry_span"):
+                try:
+                    exc.telemetry_span = row["span"]
+                except Exception:  # noqa: BLE001 - exceptions with __slots__
+                    pass
+            raise
+        finally:
+            stack.pop()
+            self._finish_row(row, t0)
+
+    def begin(self, stage: str, video: Optional[str] = None, **extra: Any) -> Optional[SpanToken]:
+        """Non-lexical span open; pair with ``token.finish()``. The span
+        records the opener's thread and current parent but is NOT pushed
+        on the nesting stack (the interval may outlive the opening
+        frame, e.g. an io/ reader's lifetime)."""
+        if not self.enabled:
+            return None
+        row = self._new_row(stage, video, extra)
+        return SpanToken(self, row, time.monotonic())
+
+    def point(self, stage: str, **extra: Any) -> None:
+        """Zero-duration event span (compile events)."""
+        if not self.enabled:
+            return
+        row = self._new_row(stage, None, extra)
+        self._finish_row(row, time.monotonic())
+
+    # -- registry hooks -------------------------------------------------
+
+    def note_bucket(self, key: Any) -> None:
+        with self._lock:
+            self._buckets.add(key)
+        self.metrics.set_gauge("buckets_seen", len(self._buckets))
+
+    def buckets_seen(self) -> int:
+        with self._lock:
+            return len(self._buckets)
+
+    def count_h2d(self, payload: Any) -> None:
+        n = payload_nbytes(payload)
+        if n:
+            self.metrics.inc("h2d_bytes", n)
+
+    # -- recompile watch ------------------------------------------------
+
+    def arm_recompile_watch(self, manifest: Any) -> None:
+        """Attach a ``jax_log_compiles`` listener recording compile
+        events as point spans and warning (once per fn name, via the
+        manifest) when a device-preprocess family exceeds its committed
+        per-bucket budget at runtime. Latest-wins process-global: arming
+        detaches any previously armed watch (the log handler and the
+        jax_log_compiles flag are process state)."""
+        global _WATCH
+        if not self.enabled or self._watch is not None:
+            return
+        watch = RecompileWatch(self, manifest)
+        with _STATE_LOCK:
+            prev, _WATCH = _WATCH, watch
+        if prev is not None:
+            prev.detach()
+        watch.attach()
+        self._watch = watch
+
+    # -- sinks ----------------------------------------------------------
+
+    def flush(self) -> None:
+        """Drain buffered spans to the JSONL file and refresh the
+        metrics snapshot. Called by the shared drain thread and by
+        :meth:`close`; safe from any thread."""
+        with self._flush_lock:
+            with self._lock:
+                rows = list(self._rows)
+                self._rows.clear()
+            if self._path is not None and rows:
+                if self._file is None:
+                    self._file = open(self._path, "a", encoding="utf-8")
+                f = self._file
+                for r in rows:
+                    f.write(json.dumps(r, default=str) + "\n")
+                f.flush()
+            if self._metrics_path is not None:
+                snap = self.metrics.snapshot()
+                snap["run"] = self.run_id
+                snap["buckets_seen"] = self.buckets_seen()
+                tmp = self._metrics_path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, self._metrics_path)
+
+    def maybe_heartbeat(self) -> None:
+        if self._next_heartbeat is None or time.monotonic() < self._next_heartbeat:
+            return
+        self._next_heartbeat = time.monotonic() + self.heartbeat_s
+        print(self.heartbeat_line(), file=sys.stderr, flush=True)
+
+    def heartbeat_line(self) -> str:
+        done = int(self.metrics.counter("videos_done"))
+        frames = int(self.metrics.counter("frames_decoded"))
+        elapsed = max(time.time() - self.metrics.t_start, 1e-9)
+        vps = done / elapsed
+        fps = frames / elapsed
+        total = self.total_videos
+        if total and vps > 0:
+            eta = f"{(total - done) / vps:.0f}s"
+        else:
+            eta = "?"
+        frac = f"{done}/{total}" if total else f"{done}"
+        return (
+            f"telemetry: {frac} videos, {vps:.2f} videos/s, "
+            f"{fps:.0f} decode fps, eta {eta}"
+        )
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """All spans recorded so far (memory mode only reflects the
+        bounded retention window). Flushes first so the file is
+        complete."""
+        self.flush()
+        if self._path is not None:
+            return read_spans(self._path)
+        with self._lock:
+            return list(self._mem)
+
+    def close(self) -> None:
+        """Final flush, detach the recompile watch, release the file.
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._watch is not None:
+            global _WATCH
+            self._watch.detach()
+            with _STATE_LOCK:
+                if _WATCH is self._watch:
+                    _WATCH = None
+            self._watch = None
+        self.flush()
+        with self._flush_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+        _TARGETS.discard(self)
+
+
+NULL_TELEMETRY = Telemetry(enabled=False)
+
+
+class RecompileWatch:
+    """``jax_log_compiles`` listener for production runs.
+
+    Reuses the CompileCounter machinery (same logger names + regex) but
+    instead of asserting a test scenario it (a) records every executable
+    build as a zero-duration ``compile`` span + ``compiles`` counter and
+    (b) emits ONE manifest *warning* per jitted-fn name whose build
+    count exceeds ``per_bucket_ceiling(name) * max(1, buckets seen)`` —
+    the runtime form of the GC401 invariant that executables are shared
+    per bucket, so compiles must scale with distinct buckets, never with
+    videos. Ceilings come from ``analysis/compile_budget.json`` (the min
+    across scenarios budgeting the name, i.e. the tightest committed
+    per-corpus ceiling)."""
+
+    def __init__(self, tele: Telemetry, manifest: Any) -> None:
+        self.tele = tele
+        self.manifest = manifest
+        self.counts: Dict[str, int] = {}
+        self.warned: set = set()
+        self._lock = threading.Lock()
+        self._handler: Optional[Any] = None
+        self._prev_flag: Optional[bool] = None
+        self.limits = runtime_compile_limits()
+
+    def attach(self) -> None:
+        import logging
+
+        import jax
+
+        from video_features_tpu.analysis import compile_budget as cb
+
+        watch = self
+
+        class _Handler(logging.Handler):
+            def emit(self, record: logging.LogRecord) -> None:
+                try:
+                    m = cb._COMPILING_RE.match(record.getMessage())
+                except Exception:  # noqa: BLE001 - a broken record must not kill the run
+                    return
+                if m:
+                    watch.on_compile(m.group(1))
+
+        handler = _Handler(level=logging.DEBUG)
+        self._prev_flag = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        for name in cb._LOGGER_NAMES:
+            logging.getLogger(name).addHandler(handler)
+        self._handler = handler
+
+    def detach(self) -> None:
+        if self._handler is None:
+            return
+        import logging
+
+        import jax
+
+        from video_features_tpu.analysis import compile_budget as cb
+
+        for name in cb._LOGGER_NAMES:
+            logging.getLogger(name).removeHandler(self._handler)
+        if self._prev_flag is not None:
+            jax.config.update("jax_log_compiles", bool(self._prev_flag))
+        self._handler = None
+
+    def on_compile(self, fn_name: str) -> None:
+        with self._lock:
+            self.counts[fn_name] = self.counts.get(fn_name, 0) + 1
+            count = self.counts[fn_name]
+            already_warned = fn_name in self.warned
+        self.tele.metrics.inc("compiles")
+        self.tele.point("compile", fn=fn_name, n=count)
+        ceiling = self.limits.get(fn_name)
+        if ceiling is None or already_warned:
+            return
+        allowance = ceiling * max(1, self.tele.buckets_seen())
+        if count > allowance:
+            with self._lock:
+                if fn_name in self.warned:
+                    return
+                self.warned.add(fn_name)
+            try:
+                self.manifest.record(
+                    None, "warning", stage="compile",
+                    message=(
+                        f"recompile watch: {fn_name!r} built {count} executables, "
+                        f"runtime allowance is {allowance} "
+                        f"({ceiling}/bucket x {max(1, self.tele.buckets_seen())} "
+                        f"buckets seen) — per-video state may be leaking into "
+                        f"trace-time (see analysis/compile_budget.json)"
+                    ),
+                )
+            except Exception:  # noqa: BLE001 - observability must never kill the run
+                pass
+
+
+def runtime_compile_limits(path: Optional[str] = None) -> Dict[str, int]:
+    """Per-bucket runtime ceilings derived from compile_budget.json: for
+    each budgeted fn name, the MIN ceiling across scenarios (tightest
+    committed per-corpus bound). The watch multiplies by observed
+    distinct buckets, so a 10-bucket corpus legitimately compiling 10
+    ``encode_raw`` variants stays quiet while an O(videos) leak fires."""
+    from video_features_tpu.analysis.compile_budget import load_budget
+
+    limits: Dict[str, int] = {}
+    try:
+        scenarios = load_budget(path)
+    except Exception:  # noqa: BLE001 - missing budget file disables enforcement
+        return limits
+    for spec in scenarios.values():
+        for name, ceiling in spec.get("max_compiles", {}).items():
+            limits[name] = min(limits.get(name, ceiling), int(ceiling))
+    return limits
+
+
+# -- pure helpers (no Telemetry state) ----------------------------------
+
+
+def payload_nbytes(payload: Any) -> int:
+    """Total array bytes in a (possibly nested) host payload, duck-typed
+    on ``.nbytes`` so no numpy import is needed here."""
+    n = getattr(payload, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(payload, dict):
+        return sum(payload_nbytes(v) for v in payload.values())
+    if isinstance(payload, (list, tuple)):
+        return sum(payload_nbytes(v) for v in payload)
+    return 0
+
+
+def read_spans(path: str) -> List[Dict[str, Any]]:
+    """Load one spans-*.jsonl file, skipping torn trailing lines."""
+    rows: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    return rows
+
+
+def _intersect(xs: List[Tuple[float, float]], ys: List[Tuple[float, float]]) -> float:
+    """Seconds where the two (already merged-disjoint, sorted) interval
+    unions overlap."""
+    total = 0.0
+    i = j = 0
+    while i < len(xs) and j < len(ys):
+        a = max(xs[i][0], ys[j][0])
+        b = min(xs[i][1], ys[j][1])
+        if b > a:
+            total += b - a
+        if xs[i][1] < ys[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _merged(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    if not intervals:
+        return []
+    intervals.sort()
+    out = [list(intervals[0])]
+    for a, b in intervals[1:]:
+        if a > out[-1][1]:
+            out.append([a, b])
+        else:
+            out[-1][1] = max(out[-1][1], b)
+    return [(a, b) for a, b in out]
+
+
+def overlap_report(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Overlap efficiency from span intervals: how much of the run's
+    wall time had host ingest (decode/reencode/prepare) running
+    concurrently with device work (h2d/dispatch/fetch).
+
+    ``overlap_efficiency`` is overlap seconds / wall seconds — the
+    headline the async-ingest PR is judged on. ``overlap_of_device``
+    (overlap / device-busy) answers the sharper question: while the
+    chip was busy, was the host feeding it? Single-process spans only
+    use monotonic clocks, so rows from different pids are compared
+    per-pid and summed."""
+    by_pid: Dict[int, Tuple[list, list]] = {}
+    for r in rows:
+        stage = r.get("stage")
+        t0, t1 = r.get("t0"), r.get("t1")
+        if t0 is None or t1 is None or t1 < t0:
+            continue
+        pid = int(r.get("pid", 0))
+        h, d = by_pid.setdefault(pid, ([], []))
+        if stage in HOST_STAGES:
+            h.append((float(t0), float(t1)))
+        elif stage in DEVICE_STAGES:
+            d.append((float(t0), float(t1)))
+    wall = host_busy = dev_busy = overlap = 0.0
+    for h, d in by_pid.values():
+        host, dev = _merged(h), _merged(d)
+        host_busy += sum(b - a for a, b in host)
+        dev_busy += sum(b - a for a, b in dev)
+        overlap += _intersect(host, dev)
+        ts = [a for a, _ in host] + [a for a, _ in dev]
+        te = [b for _, b in host] + [b for _, b in dev]
+        if ts:
+            wall += max(te) - min(ts)
+    return {
+        "wall_s": wall,
+        "host_busy_s": host_busy,
+        "device_busy_s": dev_busy,
+        "overlap_s": overlap,
+        "overlap_efficiency": (overlap / wall) if wall > 0 else 0.0,
+        "overlap_of_device": (overlap / dev_busy) if dev_busy > 0 else 0.0,
+        "spans": sum(len(h) + len(d) for h, d in by_pid.values()),
+    }
+
+
+def spans_to_chrome_trace(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome-trace ("Trace Event Format") JSON from span rows, loadable
+    in Perfetto / chrome://tracing. Complete ("X") events with µs
+    ``ts``/``dur`` rebased to the earliest span, plus thread_name
+    metadata so lanes are labelled decode-*/worker threads."""
+    events: List[Dict[str, Any]] = []
+    t_base = min(
+        (float(r["t0"]) for r in rows if r.get("t0") is not None),
+        default=0.0,
+    )
+    seen_threads: set = set()
+    for r in rows:
+        t0, t1 = r.get("t0"), r.get("t1")
+        if t0 is None or t1 is None:
+            continue
+        pid = int(r.get("pid", 0))
+        tid = int(r.get("thread", 0))
+        key = (pid, tid)
+        if key not in seen_threads and r.get("thread_name"):
+            seen_threads.add(key)
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": r["thread_name"]},
+            })
+        args = {
+            k: v for k, v in r.items()
+            if k not in ("stage", "t0", "t1", "pid", "thread", "thread_name")
+            and v is not None
+        }
+        events.append({
+            "ph": "X",
+            "name": r.get("stage", "?"),
+            "cat": r.get("stage", "?"),
+            "ts": int(round((float(t0) - t_base) * 1e6)),
+            "dur": max(int(round((float(t1) - float(t0)) * 1e6)), 0),
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    events.sort(key=lambda e: (e.get("ts", -1), e["ph"] != "M"))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- summary.json integration ------------------------------------------
+
+
+def merge_metrics_files(output_root: str) -> Optional[Dict[str, Any]]:
+    """Merge every ``_telemetry/metrics-*.json`` under ``output_root``:
+    counters sum, gauges max, histograms merge bucket-wise. Returns None
+    when no telemetry was recorded."""
+    paths = sorted(glob.glob(os.path.join(output_root, "_telemetry", "metrics-*.json")))
+    if not paths:
+        return None
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    t_start: Optional[float] = None
+    t_end: Optional[float] = None
+    buckets = 0
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                snap = json.load(f)
+        except Exception:  # noqa: BLE001 - torn snapshot from a crashed process
+            continue
+        for k, v in snap.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in snap.get("gauges", {}).items():
+            gauges[k] = max(gauges.get(k, v), v)
+        for k, h in snap.get("histograms", {}).items():
+            cur = hists.get(k)
+            if cur is None:
+                hists[k] = {
+                    "count": h["count"], "sum": h["sum"],
+                    "min": h["min"], "max": h["max"],
+                    "bounds": h["bounds"], "buckets": list(h["buckets"]),
+                }
+            else:
+                cur["count"] += h["count"]
+                cur["sum"] += h["sum"]
+                cur["min"] = min(cur["min"], h["min"])
+                cur["max"] = max(cur["max"], h["max"])
+                cur["buckets"] = [a + b for a, b in zip(cur["buckets"], h["buckets"])]
+        ts = snap.get("t_start")
+        te = snap.get("t_snapshot")
+        if ts is not None:
+            t_start = ts if t_start is None else min(t_start, ts)
+        if te is not None:
+            t_end = te if t_end is None else max(t_end, te)
+        buckets = max(buckets, int(snap.get("buckets_seen", 0)))
+    if t_start is None:
+        t_start = t_end = 0.0
+    wall = max((t_end or 0.0) - t_start, 1e-9)
+    done = counters.get("videos_done", 0)
+    frames = counters.get("frames_decoded", 0)
+    decode_s = hists.get("stage_s.decode", {}).get("sum", 0.0)
+    return {
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+        "buckets_seen": buckets,
+        "stages": {
+            name[len("stage_s."):]: {"seconds": h["sum"], "calls": h["count"]}
+            for name, h in hists.items() if name.startswith("stage_s.")
+        },
+        "throughput": {
+            "wall_s": wall,
+            "videos_per_s": done / wall,
+            "decode_fps": (frames / decode_s) if decode_s > 0 else (frames / wall),
+        },
+    }
+
+
+def collect(output_root: str) -> Optional[Dict[str, Any]]:
+    """The ``summary.json`` telemetry block: merged metrics plus the
+    overlap report over every spans file under ``output_root``."""
+    block = merge_metrics_files(output_root)
+    span_paths = sorted(glob.glob(os.path.join(output_root, "_telemetry", "spans-*.jsonl")))
+    rows: List[Dict[str, Any]] = []
+    for p in span_paths:
+        rows.extend(read_spans(p))
+    if block is None and not rows:
+        return None
+    if block is None:
+        block = {}
+    if rows:
+        block["overlap"] = overlap_report(rows)
+        block["span_files"] = [os.path.basename(p) for p in span_paths]
+    return block

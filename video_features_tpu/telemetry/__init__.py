@@ -1,0 +1,36 @@
+"""Telemetry consumers: span schema + ``python -m video_features_tpu.telemetry``.
+
+The recording engine lives in :mod:`video_features_tpu.runtime.telemetry`
+(it is part of the hot path and belongs with faults.py under runtime/);
+this package is the read side — the committed span JSONL schema
+(``spans_schema.json``, validated in tests like
+``analysis/findings_schema.json``) and the CLI consumers in
+``__main__.py``: ``export`` (spans → Chrome-trace/Perfetto JSON) and
+``report`` (overlap-efficiency summary). The engine's public names are
+re-exported here so consumers can import one module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from video_features_tpu.runtime.telemetry import (  # noqa: F401
+    DEVICE_STAGES,
+    HOST_STAGES,
+    STAGES,
+    MetricsRegistry,
+    Telemetry,
+    collect,
+    overlap_report,
+    read_spans,
+    spans_to_chrome_trace,
+)
+
+SCHEMA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "spans_schema.json")
+
+
+def load_schema() -> dict:
+    with open(SCHEMA_PATH, "r", encoding="utf-8") as f:
+        return json.load(f)

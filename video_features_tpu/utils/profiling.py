@@ -22,16 +22,33 @@ _trace_refs = 0
 
 @contextmanager
 def device_trace(profile_dir: Optional[str]) -> Iterator[None]:
-    """Refcounted jax.profiler trace over a region; no-op when dir is None."""
+    """Refcounted jax.profiler trace over a region; no-op when dir is None.
+
+    Exception-safe: if ``start_trace`` raises (unwritable dir, profiler
+    already running outside us), the refcount is NOT bumped and any
+    half-started profiler session is stopped best-effort, so a later
+    caller sees refs==0 and can start cleanly instead of deadlocking on
+    a wedged session or double-starting. The dir is created up front —
+    the profiler's own error for a missing path is opaque."""
     global _trace_refs
     if not profile_dir:
         yield
         return
+    import os
+
     import jax
 
+    os.makedirs(profile_dir, exist_ok=True)
     with _trace_lock:
         if _trace_refs == 0:
-            jax.profiler.start_trace(profile_dir)
+            try:
+                jax.profiler.start_trace(profile_dir)
+            except BaseException:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 - nothing was started; leave refs at 0
+                    pass
+                raise
         _trace_refs += 1
     try:
         yield
