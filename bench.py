@@ -1059,6 +1059,78 @@ def _sub_analysis_overhead() -> dict:
     }
 
 
+def _sub_serve_latency() -> dict:
+    """Serving-daemon admission path (video_features_tpu/serve, ISSUE 7):
+    cold-first-request latency (model build + first jit, the cost
+    ``serve warmup`` exists to move off the request path) vs warm-request
+    latency on the resident extractor, then batched-vs-serial throughput
+    for a burst of same-bucket requests — the coalescing win: the burst
+    crosses the loop in ceil(N / max_group_size) fused dispatches instead
+    of N serial ones. CPU resnet18 with random init: relative numbers
+    (cold/warm ratio, batched speedup) are the artifact, not absolutes."""
+    from video_features_tpu.config import parse_serve_args
+    from video_features_tpu.serve.daemon import ServeDaemon
+    from video_features_tpu.utils.synth import synth_video
+
+    group, n_burst = 3, 6
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        vids = [
+            synth_video(os.path.join(tmp, f"v{i}.mp4"),
+                        n_frames=10, width=96, height=64, seed=i)
+            for i in range(n_burst)
+        ]
+        scfg = parse_serve_args([
+            "--feature_types", "resnet18",
+            "--output_path", os.path.join(tmp, "out"),
+            "--tmp_path", os.path.join(tmp, "tmp"),
+            "--allow_random_init", "--cpu", "--heartbeat_s", "0",
+            "--max_group_size", str(group), "--batch_size", str(group),
+        ])
+        d = ServeDaemon(scfg)
+        seq = iter(range(10_000))
+
+        def run_one(vid: str) -> float:
+            # submit + drain inline on this thread: latency is admission
+            # -> fused dispatch -> terminal record, no thread wakeups
+            t0 = time.perf_counter()
+            d.submit({"feature_type": "resnet18", "video_path": vid,
+                      "bucket": "96x64", "id": f"bench-{next(seq)}"},
+                     source="local")
+            for g in d.batcher.take_ready(now=float("inf")):
+                d.batcher._run_group(g)
+            return time.perf_counter() - t0
+
+        cold_s = run_one(vids[0])  # pays build + first jit
+        warm_s = min(run_one(vids[0]) for _ in range(3))
+        serial_t0 = time.perf_counter()
+        for v in vids:
+            run_one(v)
+        serial_s = time.perf_counter() - serial_t0
+        # the same burst coalesced: admit all, then drain once
+        batched_t0 = time.perf_counter()
+        for v in vids:
+            d.submit({"feature_type": "resnet18", "video_path": v,
+                      "bucket": "96x64", "id": f"bench-{next(seq)}"},
+                     source="local")
+        for g in d.batcher.take_ready(now=float("inf")):
+            d.batcher._run_group(g)
+        batched_s = time.perf_counter() - batched_t0
+        counts = d.tracker.counts()
+        d.shutdown()
+        out["serve_cold_first_request_s"] = round(cold_s, 3)
+        out["serve_warm_request_s"] = round(warm_s, 3)
+        out["serve_cold_over_warm"] = round(cold_s / max(warm_s, 1e-9), 1)
+        out["serve_serial_rps"] = round(n_burst / serial_s, 3)
+        out["serve_batched_rps"] = round(n_burst / batched_s, 3)
+        out["serve_batched_speedup"] = round(serial_s / max(batched_s, 1e-9), 2)
+        out["serve_burst_n"] = n_burst
+        out["serve_max_group_size"] = group
+        out["serve_requests_done"] = counts.get("done", 0)
+        out["serve_requests_failed"] = counts.get("failed", 0)
+    return out
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -1076,6 +1148,7 @@ SUB_PARTS = {
     "fault_overhead": _sub_fault_overhead,
     "telemetry_overhead": _sub_telemetry_overhead,
     "analysis_overhead": _sub_analysis_overhead,
+    "serve_latency": _sub_serve_latency,
 }
 
 
@@ -1249,6 +1322,10 @@ def main() -> None:
     emit()
     # graftcheck latency budget (pure host: AST only, no device work)
     extra.update(_spawn_sub("analysis_overhead", 120.0, env={"JAX_PLATFORMS": "cpu"}))
+    emit()
+    # serving daemon: cold-vs-warm request latency and the coalescing
+    # throughput win, on the same CPU backend as the host parts
+    extra.update(_spawn_sub("serve_latency", 300.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
 
     if not _probe_backend(fatal=False):

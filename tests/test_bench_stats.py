@@ -166,6 +166,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "fault_overhead": {"fault_bookkeeping_us_per_video": 12.0},
         "analysis_overhead": {"analysis_graftcheck_cold_s": 0.7},
         "telemetry_overhead": {"telemetry_overhead_us_per_video": 15.0},
+        "serve_latency": {"serve_warm_request_s": 0.5},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -197,6 +198,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["fault_bookkeeping_us_per_video"] == 12.0
     assert final["extra"]["analysis_graftcheck_cold_s"] == 0.7
     assert final["extra"]["telemetry_overhead_us_per_video"] == 15.0
+    assert final["extra"]["serve_warm_request_s"] == 0.5
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -230,6 +232,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"analysis_graftcheck_cold_s": 0.7}
         if name == "telemetry_overhead":  # span engine micro-bench, CPU-pinned
             return {"telemetry_overhead_us_per_video": 15.0}
+        if name == "serve_latency":  # serve admission bench, CPU-pinned
+            return {"serve_warm_request_s": 0.5}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

@@ -95,6 +95,9 @@ HOT_MODULE_PATTERNS = (
     # telemetry records inside the per-video loops; a device sync or
     # unguarded global here would tax every video (ISSUE 6)
     "runtime/telemetry.py",
+    # the daemon's per-request path: admission, dispatch glue, lifecycle
+    # writes — all on the serving fast path (ISSUE 7)
+    "serve/*.py",
 )
 
 # Thread-spawning roots for the thread-safety reachability walk: the
@@ -107,6 +110,9 @@ THREAD_ROOT_PATTERNS = (
     "io/sink.py",
     "native/__init__.py",
     "utils/profiling.py",
+    # the serve daemon: batcher dispatcher thread, HTTP handler threads,
+    # spool watcher thread all mutate shared admission/lifecycle state
+    "serve/*.py",
 )
 
 

@@ -22,6 +22,14 @@ from video_features_tpu.parallel.scheduler import (
 def main(argv=None) -> None:
     import os
 
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # the long-lived daemon (video_features_tpu/serve/): loads models
+        # once, keeps executables warm, serves requests over HTTP and/or
+        # a spool dir. `serve warmup ...` runs the preflight and exits.
+        from video_features_tpu.serve.daemon import serve_main
+
+        return serve_main(argv[1:])
     cfg = parse_args(argv)
     # before any device/compile touch, so every executable (including the
     # --preprocess device bucket grid) can hit/populate the on-disk cache

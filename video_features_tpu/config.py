@@ -12,7 +12,8 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
-from dataclasses import dataclass
+import re
+from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 FEATURE_TYPES = [
@@ -435,10 +436,14 @@ def enable_compile_cache(cfg: ExtractionConfig) -> None:
     )
 
 
-def build_arg_parser() -> argparse.ArgumentParser:
-    """The reference CLI surface (ref main.py:94-137), plus TPU knobs."""
+def build_arg_parser(feature_required: bool = True) -> argparse.ArgumentParser:
+    """The reference CLI surface (ref main.py:94-137), plus TPU knobs.
+
+    ``feature_required=False`` relaxes ``--feature_type`` for front-ends
+    that pick the feature type per request (the ``serve`` daemon declares
+    ``--feature_types`` instead)."""
     p = argparse.ArgumentParser(description="Extract features (TPU-native)")
-    p.add_argument("--feature_type", required=True, choices=FEATURE_TYPES)
+    p.add_argument("--feature_type", required=feature_required, choices=FEATURE_TYPES)
     p.add_argument("--video_paths", nargs="+", help="space-separated paths to videos")
     p.add_argument("--flow_paths", nargs="+", help="space-separated paths to video flow images")
     p.add_argument("--file_with_video_paths", help=".txt file where each line is a path")
@@ -581,3 +586,158 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def parse_args(argv: Optional[Sequence[str]] = None) -> ExtractionConfig:
     args = build_arg_parser().parse_args(argv)
     return sanity_check(ExtractionConfig.from_namespace(args))
+
+
+# ---------------------------------------------------------------------------
+# serve mode (video_features_tpu/serve/): the long-lived daemon's knobs
+# ---------------------------------------------------------------------------
+
+# every extraction flag the serve parser inherits still applies (devices,
+# dtype, weights, --preprocess device, --compile_cache, telemetry...);
+# ServeConfig only adds what a daemon needs on top: which models stay
+# resident, the request sources, and the admission-control bounds.
+
+
+@dataclass
+class ServeConfig:
+    """Knobs for ``video-features-tpu serve`` (see docs/serving.md)."""
+
+    extraction: ExtractionConfig
+    # models kept resident; requests naming anything else are rejected
+    feature_types: List[str] = field(default_factory=list)
+    # HTTP source (port=None disables; port=0 binds ephemeral, for tests)
+    host: str = "127.0.0.1"
+    port: Optional[int] = None
+    # spool source (air-gapped twin of the HTTP door; None disables)
+    spool_dir: Optional[str] = None
+    spool_poll_s: float = 0.5
+    # admission control: coalescing deadline, fused group bound, and the
+    # backpressure bound (reject/503 past max_queue admitted-not-terminal)
+    max_batch_wait_ms: float = 50.0
+    max_group_size: int = 8
+    max_queue: int = 256
+    # warmup preflight specs, each "<feature_type>:<W>x<H>"
+    warmup: List[str] = field(default_factory=list)
+    warmup_only: bool = False
+
+    def warmup_pairs(self) -> List[tuple]:
+        return [parse_warmup_spec(s) for s in self.warmup]
+
+
+def parse_warmup_spec(spec: str) -> tuple:
+    """``"<feature_type>:<W>x<H>"`` -> ``(feature_type, W, H)``; raises
+    ValueError naming the bad spec (feature types may contain ':'-free
+    slashes like CLIP-ViT-B/32, so split on the LAST colon)."""
+    ft, sep, shape = spec.rpartition(":")
+    m = re.fullmatch(r"(\d+)x(\d+)", shape) if sep else None
+    if not ft or m is None:
+        raise ValueError(
+            f"bad warmup spec {spec!r}: expected <feature_type>:<W>x<H>, "
+            "e.g. CLIP-ViT-B/32:640x480"
+        )
+    if ft not in FEATURE_TYPES:
+        raise ValueError(f"bad warmup spec {spec!r}: unknown feature_type {ft!r}")
+    w, h = int(m.group(1)), int(m.group(2))
+    if w < 16 or h < 16:
+        raise ValueError(f"bad warmup spec {spec!r}: sides must be >= 16")
+    return (ft, w, h)
+
+
+def build_serve_arg_parser() -> argparse.ArgumentParser:
+    """The extraction parser (feature type optional — it is per-request
+    in serve mode) plus the daemon flags."""
+    p = build_arg_parser(feature_required=False)
+    p.description = "Run the long-lived extraction daemon"
+    g = p.add_argument_group("serve")
+    g.add_argument("--feature_types", nargs="+", choices=FEATURE_TYPES,
+                   help="models to keep resident; requests naming "
+                        "anything else are rejected (default: just "
+                        "--feature_type)")
+    g.add_argument("--host", default="127.0.0.1",
+                   help="HTTP bind address (default loopback; put a real "
+                        "proxy in front before exposing further)")
+    g.add_argument("--port", type=int, default=None,
+                   help="HTTP port (0 = ephemeral; omit to disable the "
+                        "HTTP source)")
+    g.add_argument("--spool_dir", type=str, default=None,
+                   help="watched spool directory of request JSON files "
+                        "(air-gapped source; omit to disable)")
+    g.add_argument("--spool_poll_s", type=float, default=0.5,
+                   help="spool poll interval in seconds")
+    g.add_argument("--max_batch_wait_ms", type=float, default=50.0,
+                   help="max milliseconds a request waits for same-"
+                        "(feature_type, bucket) company before its group "
+                        "dispatches anyway")
+    g.add_argument("--max_group_size", type=int, default=8,
+                   help="max requests fused into one --video_batch group")
+    g.add_argument("--max_queue", type=int, default=256,
+                   help="admission bound: requests admitted but not yet "
+                        "terminal; past it new requests get 503/rejected")
+    g.add_argument("--warmup", action="append", default=None,
+                   metavar="FEATURE_TYPE:WxH",
+                   help="pre-build the fused executable for this "
+                        "(feature_type, resolution) pair before accepting "
+                        "traffic; repeatable")
+    return p
+
+
+def parse_serve_args(argv: Optional[Sequence[str]] = None) -> ServeConfig:
+    """Parse ``serve [warmup] <flags>`` into a validated ServeConfig.
+    A leading bare ``warmup`` token selects preflight-only mode (build
+    the declared executables against --compile_cache, then exit)."""
+    argv = list(argv if argv is not None else [])
+    warmup_only = bool(argv) and argv[0] == "warmup"
+    if warmup_only:
+        argv = argv[1:]
+    args = build_serve_arg_parser().parse_args(argv)
+    feature_types = args.feature_types or [args.feature_type or ExtractionConfig.feature_type]
+    cfg = ExtractionConfig.from_namespace(args)
+    cfg = sanity_check(cfg.replace(feature_type=feature_types[0]))
+    scfg = ServeConfig(
+        extraction=cfg,
+        feature_types=list(dict.fromkeys(feature_types)),
+        host=args.host,
+        port=args.port,
+        spool_dir=args.spool_dir,
+        spool_poll_s=args.spool_poll_s,
+        max_batch_wait_ms=args.max_batch_wait_ms,
+        max_group_size=args.max_group_size,
+        max_queue=args.max_queue,
+        warmup=list(args.warmup or []),
+        warmup_only=warmup_only,
+    )
+    return sanity_check_serve(scfg)
+
+
+def sanity_check_serve(scfg: ServeConfig) -> ServeConfig:
+    if not scfg.feature_types:
+        raise ValueError("serve needs at least one --feature_types entry")
+    for ft in scfg.feature_types:
+        if ft not in FEATURE_TYPES:
+            raise ValueError(f"unknown feature_type in --feature_types: {ft!r}")
+        # fail at startup, not on the first request of that type
+        sanity_check(scfg.extraction.replace(feature_type=ft))
+    if scfg.max_group_size < 1:
+        raise ValueError(f"max_group_size must be >= 1, got {scfg.max_group_size}")
+    if scfg.max_queue < 1:
+        raise ValueError(f"max_queue must be >= 1, got {scfg.max_queue}")
+    if scfg.max_batch_wait_ms < 0:
+        raise ValueError(f"max_batch_wait_ms must be >= 0, got {scfg.max_batch_wait_ms}")
+    if scfg.spool_poll_s <= 0:
+        raise ValueError(f"spool_poll_s must be > 0, got {scfg.spool_poll_s}")
+    scfg.warmup_pairs()  # raises naming any bad spec
+    if scfg.warmup_only and not scfg.warmup:
+        raise ValueError("serve warmup needs at least one --warmup FEATURE_TYPE:WxH")
+    if scfg.extraction.on_extraction not in ("save_numpy", "save_pickle"):
+        # the daemon's unit of output is a result file per request;
+        # 'print' has nothing durable to point the status record at
+        scfg = dataclasses.replace(
+            scfg, extraction=scfg.extraction.replace(on_extraction="save_numpy")
+        )
+    for ft, w, h in scfg.warmup_pairs():
+        if ft not in scfg.feature_types:
+            raise ValueError(
+                f"--warmup {ft}:{w}x{h} names a feature_type not in "
+                f"--feature_types ({', '.join(scfg.feature_types)})"
+            )
+    return scfg

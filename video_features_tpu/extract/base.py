@@ -527,6 +527,31 @@ class BaseExtractor:
             return [d for _, d in sorted(results, key=lambda t: t[0])]
         return None
 
+    def run_paths(
+        self, entries: Sequence[Any], device=None
+    ) -> Optional[List[Dict[str, np.ndarray]]]:
+        """Run extraction over ``entries`` (paths, or (video, flow)
+        tuples for disk-flow i3d) on an extractor that may already have
+        processed other videos — the serve daemon's dispatch surface.
+
+        Appends to ``path_list`` and runs the normal ``__call__`` loop
+        over just the new indices, so the warm ``_device_state`` (loaded
+        weights, per-bucket fused executables) is reused as-is: a group
+        of same-bucket entries with ``--video_batch`` > 1 fuses exactly
+        like a batch run's would, and retries/degradation/manifest all
+        apply per entry. Extractors are built once per daemon lifetime
+        and path_list grows monotonically; each entry is a fresh
+        manifest identity even if the same path was run before."""
+        entries = list(entries)
+        if not entries:
+            return [] if self.external_call else None
+        start = len(self.path_list)
+        self.path_list.extend(entries)
+        self.progress.total = len(self.path_list)
+        if self.telemetry.total_videos is not None:
+            self.telemetry.total_videos = len(self.path_list)
+        return self(range(start, len(self.path_list)), device)
+
     def _run_serial(self, indices, device, state, results) -> None:
         """The reference-shaped serial loop, now over a retry deque:
         transient failures re-enter the queue with their backoff deadline

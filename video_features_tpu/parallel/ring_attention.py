@@ -43,6 +43,26 @@ from ..ops.attention import (
     online_softmax_step,
 )
 
+# jax >= 0.6 exposes shard_map at top level with a `check_vma` kwarg; older
+# releases keep it in jax.experimental.shard_map with the same flag named
+# `check_rep`. Resolve both at import so the call site stays version-blind.
+if hasattr(jax, "shard_map"):
+    _shard_map, _CHECK_KW = jax.shard_map, "check_vma"
+else:  # pragma: no cover - exercised on jax < 0.6 installs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def _axis_size(axis_name: str) -> int:
+    # lax.axis_size is also a >= 0.6 addition; the bound axis size has
+    # always been statically known inside shard_map, just unexported.
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax._src import core as _core  # pragma: no cover - jax < 0.6
+
+    return _core.axis_frame(axis_name)
+
 
 def ring_attention(
     q: jnp.ndarray,
@@ -64,7 +84,7 @@ def ring_attention(
     score memory O(Lq_local * block_size) even when one chip's shard is
     itself too long for a single score matrix.
     """
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     axis_index = lax.axis_index(axis_name)
     l_local = k.shape[2]
     scale = q.shape[-1] ** -0.5
@@ -138,13 +158,13 @@ def ring_attention_sharded(
             f"'{head_axis}' ({mesh.shape[head_axis]})"
         )
     spec = P(None, head_axis, axis_name, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ring_attention, axis_name=axis_name, kv_len=kv_len,
                 block_size=block_size),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
     return fn(q, k, v)
 
@@ -179,11 +199,27 @@ def make_context_parallel_core(
             q_p, k_p, v_p = (jnp.pad(t, pad) for t in (q, k, v))
         else:
             q_p, k_p, v_p = q, k, v
+        if _CHECK_KW == "check_rep":
+            # jax < 0.6 workaround: the legacy shard_map mis-partitions when
+            # fused into surrounding computation in the same jit — inputs
+            # arriving auto-sharded from upstream ops (conv -> ring) and
+            # outputs consumed by a residual add both silently compute
+            # garbage. Pinning both boundaries replicated sidesteps the bad
+            # reshard; the new top-level shard_map partitions correctly
+            # without either pin.
+            rep = jax.sharding.NamedSharding(mesh, P())
+            q_p, k_p, v_p = (
+                lax.with_sharding_constraint(t, rep) for t in (q_p, k_p, v_p)
+            )
         out = ring_attention_sharded(
             q_p, k_p, v_p, mesh, axis_name=axis_name,
             kv_len=None if to == L else L, head_axis=head_axis,
             block_size=block_size,
         )
+        if _CHECK_KW == "check_rep":
+            out = lax.with_sharding_constraint(
+                out, jax.sharding.NamedSharding(mesh, P())
+            )
         return out[:, :, :L]
 
     return core

@@ -383,10 +383,11 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
     None when no manifest exists (e.g. a print-mode run).
 
     Per-video final status: the chronologically LAST terminal record
-    (done/failed) wins — so a retry that recovers reads 'done', a resume
-    run that re-fails reads 'failed', and a 'skipped' probe can never
-    demote an earlier 'done'. Videos with only non-terminal records
-    (skipped, retry) keep the last of those."""
+    (done/failed — plus 'rejected' for serve-mode request manifests)
+    wins — so a retry that recovers reads 'done', a resume run that
+    re-fails reads 'failed', and a 'skipped' probe can never demote an
+    earlier 'done'. Videos with only non-terminal records (skipped,
+    retry) keep the last of those."""
     records = iter_manifest_records(output_root)
     if not records:
         return None
@@ -409,8 +410,8 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
             continue
         cur = videos.setdefault(key, {"status": None})
         cur["attempts"] = max(int(cur.get("attempts") or 0), int(r.get("attempts") or 0))
-        terminal = status in ("done", "failed")
-        if terminal or cur["status"] not in ("done", "failed"):
+        terminal = status in ("done", "failed", "rejected")
+        if terminal or cur["status"] not in ("done", "failed", "rejected"):
             cur["status"] = status
             # 'span' links a failure to its interval in
             # _telemetry/spans-*.jsonl (runtime/telemetry.py)
@@ -420,7 +421,8 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
                     cur[field] = r[field]
                 elif field in cur and terminal:
                     del cur[field]
-    counts = {"done": 0, "failed": 0, "skipped": 0, "retry": 0, "other": 0}
+    counts = {"done": 0, "failed": 0, "skipped": 0, "retry": 0,
+              "rejected": 0, "other": 0}
     for v in videos.values():
         counts[v["status"] if v["status"] in counts else "other"] += 1
     worker_deaths = [e for e in events if e.get("event") == "worker_death"]
@@ -430,6 +432,7 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
         "done": counts["done"],
         "failed": counts["failed"],
         "skipped": counts["skipped"],
+        "rejected": counts["rejected"],
         "retries": retries,
         "warnings": warnings,
         "events": events,
@@ -471,6 +474,8 @@ def format_summary(summary: Dict[str, Any]) -> str:
         f"{summary['skipped']} skipped",
         f"{summary['retries']} retries",
     ]
+    if summary.get("rejected"):
+        parts.insert(2, f"{summary['rejected']} rejected")
     if summary["warnings"]:
         parts.append(f"{len(summary['warnings'])} warning(s)")
     if summary["worker_deaths"]:

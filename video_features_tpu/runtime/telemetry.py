@@ -47,6 +47,7 @@ import os
 import sys
 import threading
 import time
+import uuid
 import weakref
 from collections import deque
 from contextlib import contextmanager
@@ -57,12 +58,15 @@ from video_features_tpu.utils.profiling import StageTimer
 STAGES = (
     "decode", "reencode", "prepare", "h2d",
     "dispatch", "fetch", "sink", "compile", "extract",
+    "request",  # serve mode: one request's lifetime, parent of its group's stages
 )
 
 # Host-side ingest stages vs device dispatch/fetch stages, for the
 # overlap-efficiency report. ``extract`` (the serial loop's fused
 # prepare+device stage) is deliberately in neither set: the serial loop
-# has no overlap story to measure.
+# has no overlap story to measure. ``request`` is in neither either —
+# it brackets queueing + dispatch end-to-end, so counting it as busy
+# time in either set would double-book its children.
 HOST_STAGES = frozenset({"decode", "reencode", "prepare"})
 DEVICE_STAGES = frozenset({"h2d", "dispatch", "fetch"})
 
@@ -192,6 +196,10 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def gauge(self, name: str, default: Optional[float] = None) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name, default)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -255,7 +263,12 @@ class Telemetry:
         self.output_root = output_root
         self.heartbeat_s = float(heartbeat_s or 0.0)
         self.total_videos = total_videos
-        self.run_id = run_id or f"{int(time.time()):x}-{os.getpid():x}"
+        # uuid tail: a daemon builds several Telemetry instances in the
+        # same process-second (its own + one per pooled extractor), and
+        # their spans files must never collide
+        self.run_id = run_id or (
+            f"{int(time.time()):x}-{os.getpid():x}-{uuid.uuid4().hex[:6]}"
+        )
         self.timer = StageTimer()  # span-backed aggregate view
         self.metrics = MetricsRegistry()
         self._lock = threading.Lock()
@@ -456,10 +469,16 @@ class Telemetry:
         else:
             eta = "?"
         frac = f"{done}/{total}" if total else f"{done}"
-        return (
+        line = (
             f"telemetry: {frac} videos, {vps:.2f} videos/s, "
             f"{fps:.0f} decode fps, eta {eta}"
         )
+        # serve mode: surface live admission-queue depth (the bounded
+        # backpressure queue) on the same line the operator already reads
+        depth = self.metrics.gauge("queue_depth.admission")
+        if depth is not None:
+            line += f", queue {int(depth)}"
+        return line
 
     def spans(self) -> List[Dict[str, Any]]:
         """All spans recorded so far (memory mode only reflects the

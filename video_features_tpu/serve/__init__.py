@@ -1,0 +1,9 @@
+"""The long-lived extraction daemon (``video-features-tpu serve``).
+
+Modules: :mod:`.lifecycle` (request records), :mod:`.batcher`
+(bucket-keyed coalescing admission), :mod:`.daemon` (extractor pool +
+wiring + CLI), :mod:`.server` (HTTP source), :mod:`.sources` (spool
+source). Import via the submodules — this package intentionally
+re-exports nothing, so importing `video_features_tpu.serve` never drags
+in jax (lifecycle/batcher are jax-free; only daemon.py touches models).
+"""

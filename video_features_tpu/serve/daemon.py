@@ -1,0 +1,431 @@
+"""The long-lived extraction daemon: resident models, warm executables,
+request sources, and the ``serve`` CLI entry.
+
+Pieces (each its own module, wired here):
+
+- :class:`ExtractorPool` — one resident ``BaseExtractor`` per served
+  feature type, built lazily and kept for the daemon's lifetime: weights
+  load once, the per-bucket fused executables and ``--compile_cache``
+  entries stay warm, and every group dispatch rides the existing
+  ``extract/base.py`` group path (device preprocess, graceful
+  degradation, classified retries — all per request, for free).
+- :class:`~video_features_tpu.serve.batcher.AdmissionController` — the
+  bucket-keyed coalescing queue (bounded; the backpressure contract).
+- :class:`~video_features_tpu.serve.lifecycle.RequestTracker` — the
+  manifest-backed queued/dispatched/done|failed record per request.
+- sources — HTTP (:mod:`.server`) and the spool directory
+  (:mod:`.sources`), both funneling into :meth:`ServeDaemon.submit`.
+
+``serve warmup`` (or ``--warmup`` with traffic) pre-builds the fused
+executables for declared (feature_type, WxH bucket) pairs by driving a
+synthetic clip of exactly that resolution through the normal dispatch
+path — against ``--compile_cache`` the daemon's first real requests then
+never eat a compile, and RecompileWatch warnings (armed per extractor
+under ``--preprocess device``) land in the daemon's manifest log.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from video_features_tpu.config import (
+    ExtractionConfig,
+    ServeConfig,
+    sanity_check,
+)
+from video_features_tpu.extract.registry import build_extractor
+from video_features_tpu.io.sink import expected_output_files
+from video_features_tpu.runtime import faults
+from video_features_tpu.runtime import telemetry as telemetry_mod
+from video_features_tpu.runtime.telemetry import Telemetry
+from video_features_tpu.serve.batcher import AdmissionController, Key, QueueFull
+from video_features_tpu.serve.lifecycle import (
+    BadRequest,
+    ExtractionRequest,
+    RequestTracker,
+    parse_request,
+)
+
+
+class _OutcomeTee:
+    """Wraps an extractor's manifest: every record still reaches the real
+    per-video manifest; terminal per-video records (done/failed) are
+    additionally captured so the dispatcher can map them back to the
+    requests of the group it just ran. Lock-guarded — records arrive
+    from decode workers and the dispatcher thread alike."""
+
+    def __init__(self, inner: Any) -> None:
+        self._inner = inner
+        self._lock = threading.Lock()
+        self._outcomes: Dict[str, Dict[str, Any]] = {}
+
+    @property
+    def path(self):  # cli.py probes manifest.path before finalizing
+        return self._inner.path
+
+    @property
+    def output_root(self):
+        return self._inner.output_root
+
+    def record(self, video: Any, status: str, **kw: Any) -> None:
+        self._inner.record(video, status, **kw)
+        if status in ("done", "failed"):
+            with self._lock:
+                self._outcomes[str(video)] = {"status": status, **kw}
+
+    def event(self, name: str, **fields: Any) -> None:
+        self._inner.event(name, **fields)
+
+    def take(self) -> Dict[str, Dict[str, Any]]:
+        """Drain the outcomes captured since the last call (the
+        dispatcher calls this once per group, on its own thread)."""
+        with self._lock:
+            out, self._outcomes = self._outcomes, {}
+        return out
+
+
+class ExtractorPool:
+    """Resident extractors, one per feature type, built once and reused
+    for every subsequent request — the warm state a daemon exists to
+    keep (no process startup, no weight reload, no re-jit)."""
+
+    def __init__(
+        self,
+        cfg: ExtractionConfig,
+        max_group_size: int,
+        build: Callable[..., Any] = build_extractor,
+    ) -> None:
+        self._cfg = cfg
+        self._max_group_size = max(int(max_group_size), 1)
+        self._build = build
+        self._lock = threading.Lock()
+        self._extractors: Dict[str, Any] = {}
+        self.build_count: Dict[str, int] = {}
+
+    def _serving_config(self, feature_type: str) -> ExtractionConfig:
+        """The per-feature-type extraction config: the daemon's base
+        flags with the serve invariants pinned (save outputs, no resume
+        probing, group size = the admission group bound, and at least
+        one decode worker so the fused group path is reachable)."""
+        cfg = self._cfg.replace(
+            feature_type=feature_type,
+            video_paths=[],
+            flow_paths=None,
+            file_with_video_paths=None,
+            video_dir=None,
+            flow_dir=None,
+            on_extraction=(
+                self._cfg.on_extraction
+                if self._cfg.on_extraction in ("save_numpy", "save_pickle")
+                else "save_numpy"
+            ),
+            video_batch=self._max_group_size,
+            decode_workers=max(int(self._cfg.decode_workers or 0), 1),
+            resume=False,
+            retry_failed=False,
+            strict=False,
+            show_pred=False,
+        )
+        return sanity_check(cfg)
+
+    def get(self, feature_type: str) -> Any:
+        ext = self._extractors.get(feature_type)
+        if ext is None:
+            with self._lock:
+                ext = self._extractors.get(feature_type)
+                if ext is None:
+                    ext = self._build(self._serving_config(feature_type))
+                    ext.manifest = _OutcomeTee(ext.manifest)
+                    self._extractors[feature_type] = ext
+                    self.build_count[feature_type] = (
+                        self.build_count.get(feature_type, 0) + 1
+                    )
+        return ext
+
+    def feature_types(self) -> List[str]:
+        with self._lock:
+            return sorted(self._extractors)
+
+    def close(self) -> None:
+        with self._lock:
+            exts = list(self._extractors.values())
+        for ext in exts:
+            try:
+                ext.telemetry.close()
+            except Exception:  # noqa: BLE001 - shutdown must finish
+                pass
+
+
+class ServeDaemon:
+    """The daemon: glue between sources, admission, the pool, and the
+    request tracker. Construct, :meth:`start`, then :meth:`shutdown`
+    (drains by default)."""
+
+    def __init__(self, scfg: ServeConfig, build: Callable[..., Any] = build_extractor) -> None:
+        self.scfg = scfg
+        self.cfg = scfg.extraction
+        os.makedirs(self.cfg.output_path, exist_ok=True)
+        # the daemon's own telemetry: request spans, admission gauge,
+        # request counters, and the heartbeat line (which now reports
+        # live queue depth — see Telemetry.heartbeat_line)
+        self.telemetry = Telemetry(
+            output_root=self.cfg.output_path,
+            enabled=self.cfg.telemetry != "off",
+            heartbeat_s=float(self.cfg.heartbeat_s or 0.0),
+        )
+        self.tracker = RequestTracker(self.cfg.output_path, telemetry=self.telemetry)
+        self.pool = ExtractorPool(self.cfg, scfg.max_group_size, build=build)
+        self.batcher = AdmissionController(
+            dispatch=self._dispatch_group,
+            max_group_size=scfg.max_group_size,
+            max_batch_wait_s=scfg.max_batch_wait_ms / 1000.0,
+            max_queue=scfg.max_queue,
+            metrics=self.telemetry.metrics,
+        )
+        self._http_server: Any = None
+        self._http_thread: Any = None
+        self._spool: Any = None
+        self._lock = threading.Lock()
+        self._started = False
+
+    # -- the request path ------------------------------------------------
+
+    def submit(self, payload: Dict[str, Any], source: str) -> Dict[str, Any]:
+        """Parse, validate, lifecycle-admit, and queue one request.
+        Raises :class:`BadRequest` (caller -> 400 / rejected record) or
+        :class:`QueueFull` (caller -> 503 / spool backpressure); on
+        QueueFull the request is already recorded ``rejected``."""
+        req = parse_request(payload, source)
+        if req.feature_type not in self.scfg.feature_types:
+            raise BadRequest(
+                f"feature_type {req.feature_type!r} not served (serving: "
+                f"{', '.join(self.scfg.feature_types)})"
+            )
+        if not os.path.exists(req.video_path):
+            raise BadRequest(f"video_path does not exist: {req.video_path}")
+        rec = self.tracker.admit(req)
+        try:
+            self.batcher.admit(req)
+        except QueueFull:
+            if req.source == "spool":
+                # the spool file survives and re-submits under the same
+                # id next poll: back the admit out, no terminal record
+                self.tracker.forget(req)
+            else:
+                self.tracker.reject(req, f"queue full ({self.scfg.max_queue})")
+            raise
+        return rec
+
+    def _dispatch_group(self, key: Key, requests: List[ExtractionRequest]) -> None:
+        """One coalesced group -> one resident-extractor run over the
+        group's videos. Runs on the dispatcher thread; every outcome —
+        including a build/dispatch crash — lands as a terminal record on
+        every member request."""
+        feature_type = key[0]
+        try:
+            ext = self.pool.get(feature_type)
+        except Exception as exc:  # noqa: BLE001 - model build failed: fail the group
+            msg = f"extractor build failed: {type(exc).__name__}: {exc}"
+            traceback.print_exc()
+            for r in requests:
+                self.tracker.finish(
+                    r, "failed", error_class=faults.classify_error(exc),
+                    error_type=type(exc).__name__, message=msg,
+                )
+            return
+        for r in requests:
+            self.tracker.dispatched(r, group_size=len(requests))
+        # module-level telemetry hooks (decode frame counters, bucket
+        # notes) follow the extractor whose group is on the chip now
+        telemetry_mod.set_current(ext.telemetry)
+        try:
+            with ext.telemetry.span(
+                "request",
+                group_size=len(requests),
+                requests=[r.id for r in requests],
+                feature_type=feature_type,
+                bucket=key[1],
+            ):
+                ext.run_paths([r.video_path for r in requests])
+        except Exception as exc:  # noqa: BLE001 - loop-level crash: fail the group
+            traceback.print_exc()
+            outcomes = ext.manifest.take()
+            err = {
+                "error_class": faults.classify_error(exc),
+                "error_type": type(exc).__name__,
+                "message": str(exc)[:500],
+            }
+            for r in requests:
+                got = outcomes.get(r.video_path)
+                if got is not None and got["status"] == "done":
+                    self._finish_done(r, ext)
+                else:
+                    self.tracker.finish(r, "failed", **err)
+            return
+        outcomes = ext.manifest.take()
+        for r in requests:
+            got = outcomes.get(r.video_path)
+            if got is None:
+                self.tracker.finish(
+                    r, "failed", error_class="permanent",
+                    message="no terminal manifest record for this video",
+                )
+            elif got["status"] == "done":
+                self._finish_done(r, ext)
+            else:
+                self.tracker.finish(
+                    r, "failed",
+                    error_class=got.get("error_class"),
+                    error_type=got.get("error_type"),
+                    message=got.get("message"),
+                )
+
+    def _finish_done(self, req: ExtractionRequest, ext: Any) -> None:
+        files = expected_output_files(
+            ext.feature_keys(),
+            req.video_path,
+            ext.output_path,
+            ext.config.on_extraction,
+            ext.config.output_direct,
+        )
+        self.tracker.finish(req, "done", features=[f for f in files if os.path.exists(f)])
+
+    # -- warmup preflight -------------------------------------------------
+
+    def warmup(self, pairs: Optional[Sequence[Tuple[str, int, int]]] = None) -> List[Dict[str, Any]]:
+        """Pre-build the fused executables for the declared
+        (feature_type, WxH) pairs before accepting traffic: synthesize a
+        short clip at exactly that resolution and run it through the
+        normal dispatch path. With ``--compile_cache`` this is a cache
+        populate/hit, so a daemon restart warms in seconds; without it,
+        it moves the cold compile off the first user request. Returns
+        the warmup requests' terminal records."""
+        from video_features_tpu.utils.synth import synth_video
+
+        pairs = list(pairs if pairs is not None else self.scfg.warmup_pairs())
+        out: List[Dict[str, Any]] = []
+        wdir = os.path.join(self.cfg.output_path, "_warmup")
+        os.makedirs(wdir, exist_ok=True)
+        for i, (ft, w, h) in enumerate(pairs):
+            clip = os.path.join(wdir, f"warm-{w}x{h}.mp4")
+            if not os.path.exists(clip):
+                synth_video(clip, n_frames=8, width=w, height=h, seed=i)
+            req = ExtractionRequest(
+                feature_type=ft, video_path=clip,
+                bucket=f"{w}x{h}", source="warmup",
+                id=f"warmup-{ft.replace('/', '-')}-{w}x{h}",
+            )
+            self.tracker.admit(req)
+            self._dispatch_group(req.key(), [req])
+            rec = self.tracker.get(req.id) or {}
+            out.append(rec)
+            print(
+                f"serve: warmup {ft} {w}x{h}: {rec.get('state', '?')}"
+                + (f" ({rec.get('message')})" if rec.get("state") == "failed" else "")
+            )
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Warmup (if declared), then open the request sources."""
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        if self.scfg.warmup:
+            self.warmup()
+        self.batcher.start()
+        if self.scfg.spool_dir is not None:
+            from video_features_tpu.serve.sources import SpoolWatcher
+
+            self._spool = SpoolWatcher(
+                self, self.scfg.spool_dir, poll_s=self.scfg.spool_poll_s
+            )
+            self._spool.start()
+        if self.scfg.port is not None:
+            from video_features_tpu.serve.server import start_http_server
+
+            self._http_server, self._http_thread = start_http_server(
+                self, self.scfg.host, self.scfg.port
+            )
+            host, port = self._http_server.server_address[:2]
+            print(f"serve: listening on http://{host}:{port} "
+                  f"(models: {', '.join(self.scfg.feature_types)})")
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._http_server.server_address[1] if self._http_server else None
+
+    def status(self) -> Dict[str, Any]:
+        """The /healthz body: queue depth, per-state request counts, and
+        which models are warm."""
+        return {
+            "status": "ok",
+            "queue_depth": self.batcher.depth(),
+            "max_queue": self.scfg.max_queue,
+            "requests": self.tracker.counts(),
+            "serving": list(self.scfg.feature_types),
+            "warm": self.pool.feature_types(),
+        }
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop sources, drain (default) or reject the backlog, close
+        telemetry, and write the final summary.json."""
+        if self._http_server is not None:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join()
+            self._http_server = None
+            self._http_thread = None
+        if self._spool is not None:
+            self._spool.stop()
+            self._spool = None
+        for req in self.batcher.close(drain=drain):
+            self.tracker.reject(req, "daemon shutdown before dispatch")
+        self.pool.close()
+        self.telemetry.close()
+        try:
+            # two summaries: per-video extraction records (the pooled
+            # extractors' manifest under <output>/_manifest) and the
+            # per-request lifecycle records (<output>/_requests/_manifest)
+            summary = faults.finalize_run(self.cfg.output_path)
+            if summary is not None:
+                print(faults.format_summary(summary))
+            req_summary = faults.finalize_run(self.tracker.results_dir)
+            if req_summary is not None:
+                print("requests: " + faults.format_summary(req_summary))
+        except Exception:  # noqa: BLE001 - shutdown must finish
+            traceback.print_exc()
+
+
+def serve_main(argv: Optional[Sequence[str]] = None) -> None:
+    """``video-features-tpu serve [warmup] ...`` — parse, build, run.
+
+    ``serve warmup`` runs the preflight against ``--compile_cache`` and
+    exits (the deploy-time "bake the cache" step); plain ``serve`` warms
+    (if ``--warmup`` pairs are declared) and then serves until SIGINT.
+    """
+    from video_features_tpu.config import enable_compile_cache, parse_serve_args
+
+    scfg = parse_serve_args(argv)
+    enable_compile_cache(scfg.extraction)
+    daemon = ServeDaemon(scfg)
+    if scfg.warmup_only:
+        results = daemon.warmup()
+        daemon.shutdown()
+        failed = [r for r in results if r.get("state") != "done"]
+        if failed:
+            raise SystemExit(f"serve warmup: {len(failed)}/{len(results)} pair(s) failed")
+        return
+    daemon.start()
+    try:
+        threading.Event().wait()  # serve until interrupted
+    except KeyboardInterrupt:
+        print("serve: draining and shutting down")
+    finally:
+        daemon.shutdown()

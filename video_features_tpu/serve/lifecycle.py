@@ -1,0 +1,278 @@
+"""Request lifecycle: the manifest-backed record every serve request gets.
+
+A batch run's unit of record is the video (runtime/faults.py manifest);
+the daemon's unit of record is the *request* — same video, different
+identity: two users asking for the same clip are two requests, and each
+one must end in a queryable terminal state. States:
+
+    queued -> dispatched -> done | failed
+    queued -> rejected                      (backpressure / bad input)
+
+Every transition is appended to a :class:`~video_features_tpu.runtime.
+faults.RunManifest` rooted at ``<output>/_requests`` (so the extraction
+manifest under ``<output>/_manifest`` stays purely per-video), and the
+terminal state is additionally written as ``<output>/_requests/<id>.json``
+— the durable per-request result record the status endpoint serves after
+the in-memory map forgets (daemon restart). Failure records reuse the
+``classify_error`` taxonomy from runtime/faults.py, so a request that
+died of a transient decode flake reads exactly like the batch manifest
+would read it.
+
+No jax imports; everything here runs on source/HTTP threads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from video_features_tpu.runtime.faults import RunManifest
+
+REQUESTS_DIRNAME = "_requests"
+
+# queued/dispatched are transitional; done/failed/rejected are terminal
+# (merge_manifest treats all three as terminal when folding the request
+# manifest, so a restart never resurrects a rejected request as live).
+REQUEST_STATES = ("queued", "dispatched", "done", "failed", "rejected")
+TERMINAL_STATES = ("done", "failed", "rejected")
+
+# request ids become result filenames: constrain them so a hostile id
+# can never traverse out of _requests/ (the HTTP source accepts ids)
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,99}$")
+
+# the admission key's catch-all bucket for requests that do not declare
+# one: they still coalesce with each other (the extractor's own agg_key
+# keeps truly mixed shapes out of one fused dispatch)
+DEFAULT_BUCKET = "~"
+
+
+class BadRequest(ValueError):
+    """Malformed request payload (unknown feature type, missing path,
+    unsafe id). Permanent by nature: re-sending the same bytes fails
+    the same way."""
+
+
+@dataclasses.dataclass
+class ExtractionRequest:
+    """One admitted unit of work. ``bucket`` is the client's spatial-
+    bucket hint — the coalescing half of the admission key; the fused
+    dispatch itself is still guarded by the extractor's ``agg_key``, so
+    a wrong hint costs batching efficiency, never correctness."""
+
+    feature_type: str
+    video_path: str
+    id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex[:12])
+    bucket: str = DEFAULT_BUCKET
+    source: str = "local"  # http | spool | warmup | local
+    received_ts: float = dataclasses.field(default_factory=time.time)
+
+    def key(self) -> Tuple[str, str]:
+        """The admission-control key: same-(feature_type, bucket)
+        requests may coalesce into one fused --video_batch group."""
+        return (self.feature_type, self.bucket)
+
+
+def parse_request(payload: Dict[str, Any], source: str) -> ExtractionRequest:
+    """Validate one request dict (HTTP body or spool file) into an
+    :class:`ExtractionRequest`; raises :class:`BadRequest` naming the
+    problem (the sources turn that into 400 / a rejected record)."""
+    if not isinstance(payload, dict):
+        raise BadRequest(f"request body must be a JSON object, got {type(payload).__name__}")
+    ft = payload.get("feature_type")
+    if not ft or not isinstance(ft, str):
+        raise BadRequest("missing 'feature_type'")
+    video = payload.get("video_path")
+    if not video or not isinstance(video, str):
+        raise BadRequest("missing 'video_path'")
+    kw: Dict[str, Any] = {"feature_type": ft, "video_path": video, "source": source}
+    rid = payload.get("id")
+    if rid is not None:
+        if not isinstance(rid, str) or not _ID_RE.match(rid):
+            raise BadRequest(
+                "bad 'id': need 1-100 chars of [A-Za-z0-9._-] starting alphanumeric"
+            )
+        kw["id"] = rid
+    bucket = payload.get("bucket")
+    if bucket is not None:
+        if not isinstance(bucket, str) or len(bucket) > 32:
+            raise BadRequest("bad 'bucket': expected a short string like '640x480'")
+        kw["bucket"] = bucket
+    return ExtractionRequest(**kw)
+
+
+def requests_root(output_root: str) -> str:
+    return os.path.join(output_root, REQUESTS_DIRNAME)
+
+
+class RequestTracker:
+    """Thread-safe request registry + the manifest/result-file writers.
+
+    Sources admit from their own threads, the batcher's dispatcher
+    transitions from its thread, and the status endpoint reads from HTTP
+    handler threads — one lock covers the in-memory map; the manifest
+    has its own (runtime/faults.py)."""
+
+    def __init__(self, output_root: str, telemetry: Any = None) -> None:
+        self.output_root = output_root
+        self.results_dir = requests_root(output_root)
+        self.manifest = RunManifest(self.results_dir)
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._records: Dict[str, Dict[str, Any]] = {}
+        self._spans: Dict[str, Any] = {}  # request id -> open telemetry token
+
+    # -- transitions ----------------------------------------------------
+
+    def admit(self, req: ExtractionRequest) -> Dict[str, Any]:
+        rec = {
+            "id": req.id,
+            "state": "queued",
+            "feature_type": req.feature_type,
+            "video_path": req.video_path,
+            "bucket": req.bucket,
+            "source": req.source,
+            "received_ts": round(req.received_ts, 4),
+        }
+        with self._lock:
+            if req.id in self._records:
+                raise BadRequest(f"duplicate request id {req.id!r}")
+            self._records[req.id] = rec
+        self._count("requests_admitted")
+        if self.telemetry is not None and self.telemetry.enabled:
+            token = self.telemetry.begin(
+                "request", video=req.video_path, request=req.id,
+                feature_type=req.feature_type, bucket=req.bucket,
+            )
+            if token is not None:
+                with self._lock:
+                    self._spans[req.id] = token
+        self.manifest.record(
+            f"request:{req.id}", "queued",
+            feature_type=req.feature_type, video_path=req.video_path,
+            bucket=req.bucket, source=req.source,
+        )
+        return dict(rec)
+
+    def dispatched(self, req: ExtractionRequest, group_size: int) -> None:
+        with self._lock:
+            rec = self._records.get(req.id)
+            if rec is not None:
+                rec["state"] = "dispatched"
+                rec["group_size"] = int(group_size)
+        self.manifest.record(
+            f"request:{req.id}", "dispatched", group_size=int(group_size)
+        )
+
+    def finish(
+        self,
+        req: ExtractionRequest,
+        status: str,
+        error_class: Optional[str] = None,
+        error_type: Optional[str] = None,
+        message: Optional[str] = None,
+        features: Optional[List[str]] = None,
+    ) -> Dict[str, Any]:
+        """Terminal transition (done/failed/rejected): update the map,
+        append the manifest record, write the durable result JSON, and
+        close the request telemetry span."""
+        assert status in TERMINAL_STATES, status
+        with self._lock:
+            rec = self._records.get(req.id)
+            if rec is None:
+                rec = {"id": req.id, "video_path": req.video_path,
+                       "feature_type": req.feature_type, "bucket": req.bucket}
+                self._records[req.id] = rec
+            rec["state"] = status
+            rec["finished_ts"] = round(time.time(), 4)
+            rec["wall_s"] = round(rec["finished_ts"] - rec.get("received_ts", rec["finished_ts"]), 4)
+            if error_class is not None:
+                rec["error_class"] = error_class
+            if error_type is not None:
+                rec["error_type"] = error_type
+            if message is not None:
+                rec["message"] = str(message)[:500]
+            if features is not None:
+                rec["features"] = list(features)
+            out = dict(rec)
+            token = self._spans.pop(req.id, None)
+        if token is not None:
+            token.finish(state=status)
+        self._count(f"requests_{status}")
+        extra = {
+            k: out[k]
+            for k in ("error_class", "error_type", "message", "wall_s")
+            if k in out
+        }
+        self.manifest.record(f"request:{req.id}", status, **extra)
+        self._write_result(out)
+        return out
+
+    def forget(self, req: ExtractionRequest) -> None:
+        """Back out an admit that never reached the queue (spool
+        backpressure): the spool file stays on disk and will be
+        re-submitted later under the SAME id, so no live record may
+        linger to collide with it. The append-only manifest keeps the
+        'queued' line and gains a non-terminal 'deferred' one — a later
+        re-admit simply re-records."""
+        with self._lock:
+            self._records.pop(req.id, None)
+            token = self._spans.pop(req.id, None)
+        if token is not None:
+            token.finish(state="deferred")
+        self._count("requests_deferred")
+        self.manifest.record(f"request:{req.id}", "deferred")
+
+    def reject(self, req: ExtractionRequest, reason: str) -> Dict[str, Any]:
+        """Backpressure / bad-input terminal state: the request never
+        reached the admission queue."""
+        return self.finish(
+            req, "rejected", error_class="rejected", message=reason
+        )
+
+    # -- queries --------------------------------------------------------
+
+    def get(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The live record, falling back to the durable result file for
+        requests finished before a daemon restart."""
+        with self._lock:
+            rec = self._records.get(request_id)
+            if rec is not None:
+                return dict(rec)
+        if not _ID_RE.match(request_id or ""):
+            return None
+        path = os.path.join(self.results_dir, f"{request_id}.json")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {s: 0 for s in REQUEST_STATES}
+            for rec in self._records.values():
+                s = rec.get("state")
+                if s in out:
+                    out[s] += 1
+        return out
+
+    # -- internals ------------------------------------------------------
+
+    def _count(self, name: str) -> None:
+        if self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.metrics.inc(name)
+
+    def _write_result(self, rec: Dict[str, Any]) -> None:
+        """tmp + rename so a status reader never sees a torn record."""
+        os.makedirs(self.results_dir, exist_ok=True)
+        path = os.path.join(self.results_dir, f"{rec['id']}.json")
+        tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:6]}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(rec, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)

@@ -1,0 +1,113 @@
+"""Minimal HTTP/JSON request source on stdlib ``http.server``.
+
+Endpoints (the whole surface — this is an admission door, not a web
+framework; anything fancier belongs behind a real proxy):
+
+- ``POST /v1/extract`` — body ``{"feature_type": ..., "video_path": ...,
+  "bucket"?: "WxH", "id"?: ...}``; 202 + the queued lifecycle record,
+  400 on a malformed request (recorded nowhere — it never had an
+  identity), 503 + Retry-After when the bounded admission queue is full
+  (recorded ``rejected``; the client owns the retry).
+- ``GET /v1/requests/<id>`` — the lifecycle record (memory, falling back
+  to the durable result JSON); 404 for unknown ids.
+- ``GET /healthz`` — queue depth, per-state counts, warm model list.
+
+ThreadingHTTPServer: handlers run on per-connection threads, so
+everything they touch (daemon.submit -> tracker/batcher) is lock-guarded
+— the package sits in graftcheck's GC301 thread-root scope.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Tuple
+
+from video_features_tpu.serve.lifecycle import BadRequest
+
+MAX_BODY_BYTES = 1 << 20  # a request is a few hundred bytes; 1 MiB is hostile
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    """One request in, one JSON document out. The daemon reference lives
+    on the server object (set by :func:`start_http_server`)."""
+
+    server_version = "vft-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code: int, body: Dict[str, Any], retry_after: float = 0.0) -> None:
+        data = json.dumps(body, sort_keys=True).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        if retry_after > 0:
+            self.send_header("Retry-After", str(max(int(retry_after), 1)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path.rstrip("/") != "/v1/extract":
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+            return
+        daemon = self.server.daemon  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            self._send(400, {"error": "missing or oversized Content-Length"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as exc:
+            self._send(400, {"error": f"body is not valid JSON: {exc}"})
+            return
+        try:
+            rec = daemon.submit(payload, source="http")
+        except BadRequest as exc:
+            self._send(400, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - QueueFull without importing batcher here
+            if type(exc).__name__ == "QueueFull":
+                self._send(
+                    503,
+                    {"error": str(exc), "queue_depth": daemon.batcher.depth()},
+                    retry_after=daemon.scfg.max_batch_wait_ms / 1000.0 * 2,
+                )
+                return
+            raise
+        self._send(202, rec)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        daemon = self.server.daemon  # type: ignore[attr-defined]
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            self._send(200, daemon.status())
+            return
+        prefix = "/v1/requests/"
+        if self.path.startswith(prefix):
+            rid = self.path[len(prefix):]
+            rec = daemon.tracker.get(rid)
+            if rec is None:
+                self._send(404, {"error": f"unknown request id {rid!r}"})
+            else:
+                self._send(200, rec)
+            return
+        self._send(404, {"error": f"no such endpoint: {self.path}"})
+
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        pass  # the daemon's heartbeat/manifest are the log; not per-request access lines
+
+
+def start_http_server(daemon: Any, host: str, port: int) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Bind (``port=0`` -> ephemeral, how the tests run), attach the
+    daemon, serve on a background thread. Caller owns shutdown()."""
+    server = ThreadingHTTPServer((host, port), ServeHandler)
+    server.daemon_threads = True
+    server.daemon = daemon  # type: ignore[attr-defined]
+    thread = threading.Thread(
+        target=server.serve_forever, name="serve-http", daemon=True
+    )
+    thread.start()
+    return server, thread

@@ -1,0 +1,105 @@
+"""Spool-directory request source: the air-gapped twin of the HTTP door.
+
+Protocol (documented in docs/serving.md): a producer writes a request as
+``<spool>/<name>.json`` — atomically, via write-to-temp + rename into
+the directory, exactly like the sinks in io/ — with the same schema as
+the HTTP body. The watcher polls (``--spool_poll_s``), claims a file by
+renaming it to ``<name>.json.claimed`` (rename is the mutual exclusion:
+two watchers on one spool can race a file, only one rename wins), then
+submits it:
+
+- admitted       -> claimed file is deleted; track via the result JSON
+                    under ``<output>/_requests/<id>.json``
+- malformed      -> renamed to ``<name>.json.bad`` with a ``.why`` file
+                    (and, when the payload named an id, a rejected
+                    lifecycle record) — poison files must leave the
+                    scan path or they re-fail every poll
+- queue full     -> the claim is renamed BACK to ``<name>.json``: the
+                    file system is the retry queue, which is the whole
+                    point of a spool; next poll retries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import traceback
+from typing import Any
+
+from video_features_tpu.serve.batcher import QueueFull
+from video_features_tpu.serve.lifecycle import BadRequest
+
+
+class SpoolWatcher:
+    """Polls a spool directory and feeds ``daemon.submit``. One thread;
+    start()/stop(); a single :meth:`poll_once` pass is the deterministic
+    unit the tests drive directly."""
+
+    def __init__(self, daemon: Any, spool_dir: str, poll_s: float = 0.5) -> None:
+        self.daemon = daemon
+        self.spool_dir = spool_dir
+        self.poll_s = max(float(poll_s), 0.01)
+        os.makedirs(spool_dir, exist_ok=True)
+        self._stop = threading.Event()
+        self._thread: threading.Thread = threading.Thread(
+            target=self._loop, name="serve-spool", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the watcher must outlive one bad pass
+                traceback.print_exc()
+            self._stop.wait(self.poll_s)
+
+    def poll_once(self) -> int:
+        """One scan pass; returns how many files were admitted. Stops
+        early on queue-full — everything left in the directory is
+        naturally deferred to the next poll."""
+        try:
+            names = sorted(os.listdir(self.spool_dir))
+        except OSError:
+            return 0
+        admitted = 0
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.spool_dir, name)
+            claimed = path + ".claimed"
+            try:
+                os.rename(path, claimed)  # the claim; losing the race is fine
+            except OSError:
+                continue
+            try:
+                with open(claimed, "r", encoding="utf-8") as fh:
+                    payload = json.load(fh)
+                self.daemon.submit(payload, source="spool")
+            except QueueFull:
+                os.replace(claimed, path)  # un-claim: spool = retry queue
+                return admitted
+            except (ValueError, BadRequest) as exc:
+                self._quarantine(claimed, name, exc)
+            else:
+                admitted += 1
+                os.unlink(claimed)
+        return admitted
+
+    def _quarantine(self, claimed: str, name: str, exc: Exception) -> None:
+        bad = os.path.join(self.spool_dir, name + ".bad")
+        try:
+            os.replace(claimed, bad)
+            with open(bad + ".why", "w", encoding="utf-8") as fh:
+                fh.write(f"{type(exc).__name__}: {exc}\n")
+        except OSError:
+            pass
+        print(f"serve: spool file {name} rejected: {exc}")
