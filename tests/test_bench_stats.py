@@ -167,6 +167,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "analysis_overhead": {"analysis_graftcheck_cold_s": 0.7},
         "telemetry_overhead": {"telemetry_overhead_us_per_video": 15.0},
         "serve_latency": {"serve_warm_request_s": 0.5},
+        "serve_scheduling": {"serve_sched_edf_miss_rate": 0.0},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -199,6 +200,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["analysis_graftcheck_cold_s"] == 0.7
     assert final["extra"]["telemetry_overhead_us_per_video"] == 15.0
     assert final["extra"]["serve_warm_request_s"] == 0.5
+    assert final["extra"]["serve_sched_edf_miss_rate"] == 0.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -234,6 +236,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"telemetry_overhead_us_per_video": 15.0}
         if name == "serve_latency":  # serve admission bench, CPU-pinned
             return {"serve_warm_request_s": 0.5}
+        if name == "serve_scheduling":  # pure-host FIFO-vs-EDF simulation
+            return {"serve_sched_edf_miss_rate": 0.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

@@ -419,13 +419,18 @@ def test_shutdown_drains_admitted_requests(tmp_path, serve_videos):
         assert json.load(fh)["done"] == 3
 
 
-def test_shutdown_without_drain_rejects_backlog(tmp_path, serve_videos):
+def test_shutdown_without_drain_fails_backlog_interrupted(tmp_path, serve_videos):
+    # ISSUE 8 satellite: an undrained shutdown must leave a durable
+    # terminal record for every undispatched request — failed/interrupted
+    # for non-spool sources (spool ones are re-queued instead)
     d, _ = _daemon(tmp_path, serve_videos, max_group_size=4)
     d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
               "id": "nd-0"}, source="local")
     d.shutdown(drain=False)
     rec = d.tracker.get("nd-0")
-    assert rec["state"] == "rejected" and "shutdown" in rec["message"]
+    assert rec["state"] == "failed"
+    assert rec["error_class"] == "interrupted"
+    assert "shutdown" in rec["message"]
 
 
 def test_warmup_prebuilds_and_requests_reuse(tmp_path, serve_videos):

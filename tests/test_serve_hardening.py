@@ -1,0 +1,765 @@
+"""Serve-mode hardening (ISSUE 8): scheduling, cancellation,
+supervision, crash recovery, and retention.
+
+Deterministic by construction, like test_serve.py: the scheduler is a
+pure function of (groups, now), the breaker and admission clocks are
+injected fakes, daemon tests drive the batcher's inline drain on the
+test thread, and the kill-then-restart recovery test SIGKILLs a
+subprocess that only touches the (jax-free) lifecycle module. The only
+real-time test is the watchdog hang (bounded at ~0.4 s by the injected
+hang's sleep).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from test_serve import FakeClock, ServeToy, _daemon, _req, serve_videos  # noqa: F401
+
+from video_features_tpu.config import parse_serve_args
+from video_features_tpu.runtime import faults
+from video_features_tpu.serve.batcher import AdmissionController, QueueFull
+from video_features_tpu.serve.daemon import ServeDaemon
+from video_features_tpu.serve.lifecycle import (
+    BadRequest,
+    ExtractionRequest,
+    RequestTracker,
+    parse_request,
+)
+from video_features_tpu.serve.scheduler import (
+    EdfScheduler,
+    FifoScheduler,
+    build_scheduler,
+    simulate_dispatch,
+)
+from video_features_tpu.serve.sources import SpoolWatcher, parse_spool_name
+from video_features_tpu.serve.supervisor import (
+    CircuitBreaker,
+    GroupTimeout,
+    ModelUnavailable,
+    Watchdog,
+)
+
+pytestmark = pytest.mark.serve
+
+
+# --- helpers ----------------------------------------------------------------
+
+
+def _sreq(i, bucket="64x48", priority=0, deadline_at=None, admitted_at=0.0):
+    r = _req(i, bucket=bucket)
+    r.priority = priority
+    r.admitted_at = admitted_at
+    r.deadline_at = deadline_at
+    return r
+
+
+def _group(key_bucket, *reqs):
+    return (("resnet18", key_bucket), list(reqs))
+
+
+def _drain_inline(d):
+    """What the dispatcher thread would do, on this thread: pull every
+    ready group (scheduler order) and run it."""
+    for g in d.batcher.take_ready(now=float("inf")):
+        d.batcher._run_group(g)
+
+
+def _fake_daemon(tmp_path, serve_videos, clock, **flags):
+    """test_serve's _daemon, with an injected daemon/batcher/breaker
+    clock for no-sleep deadline and breaker tests."""
+    argv = [
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu",
+        "--heartbeat_s", "0",
+    ]
+    for k, v in flags.items():
+        argv += [f"--{k}"] + ([str(v)] if v is not True else [])
+    scfg = parse_serve_args(argv)
+
+    class Toy(ServeToy):
+        built = 0
+
+    d = ServeDaemon(scfg, build=Toy, clock=clock)
+    return d, Toy
+
+
+# --- scheduler units (pure, no threads) -------------------------------------
+
+
+def test_edf_orders_across_keys_by_effective_deadline():
+    s = EdfScheduler(default_slack_s=30.0, aging_s=0.0)
+    groups = [
+        _group("a", _sreq(0, deadline_at=9.0)),
+        _group("b", _sreq(1, deadline_at=3.0)),
+        _group("c", _sreq(2, deadline_at=6.0)),
+    ]
+    ordered = s.order(groups, now=0.0)
+    assert [k[1] for k, _ in ordered] == ["b", "c", "a"]
+    assert s.pick(groups, now=0.0) == 1
+
+
+def test_edf_group_deadline_is_most_urgent_member():
+    s = EdfScheduler(aging_s=0.0)
+    groups = [
+        _group("a", _sreq(0, deadline_at=5.0), _sreq(1, deadline_at=1.0)),
+        _group("b", _sreq(2, deadline_at=2.0)),
+    ]
+    assert s.pick(groups, now=0.0) == 0  # member deadline 1.0 wins
+
+
+def test_priority_tier_dominates_deadline():
+    s = EdfScheduler(aging_s=0.0)
+    groups = [
+        _group("a", _sreq(0, priority=0, deadline_at=1.0)),
+        _group("b", _sreq(1, priority=5, deadline_at=100.0)),
+    ]
+    assert s.pick(groups, now=0.0) == 1
+
+
+def test_aging_promotes_starved_low_priority():
+    s = EdfScheduler(default_slack_s=1000.0, aging_s=10.0)
+    old = _group("a", _sreq(0, priority=0, admitted_at=0.0))
+    fresh = _group("b", _sreq(1, priority=3, admitted_at=100.0))
+    # at t=100 the tier-0 group has waited 100 s -> +10 tiers > tier 3
+    assert s.pick([old, fresh], now=100.0) == 0
+    # freshly admitted, same tiers: the higher declared priority wins
+    assert s.pick([old, fresh], now=0.5) == 1
+    # infinite drain sweeps must rank deterministically, not overflow
+    assert s.pick([old, fresh], now=float("inf")) in (0, 1)
+
+
+def test_deadline_less_requests_age_via_default_slack():
+    s = EdfScheduler(default_slack_s=5.0, aging_s=0.0)
+    groups = [
+        _group("a", _sreq(0, admitted_at=0.0)),  # effective deadline 5.0
+        _group("b", _sreq(1, deadline_at=3.0, admitted_at=1.0)),
+        _group("c", _sreq(2, deadline_at=8.0, admitted_at=1.0)),
+    ]
+    ordered = s.order(groups, now=2.0)
+    assert [k[1] for k, _ in ordered] == ["b", "a", "c"]
+
+
+def test_fifo_scheduler_preserves_arrival_order():
+    s = FifoScheduler()
+    groups = [
+        _group("a", _sreq(0, deadline_at=100.0)),
+        _group("b", _sreq(1, deadline_at=1.0)),
+    ]
+    assert s.pick(groups, now=0.0) == 0
+    assert [k[1] for k, _ in s.order(groups, now=0.0)] == ["a", "b"]
+
+
+def test_build_scheduler_names():
+    assert build_scheduler("edf").name == "edf"
+    assert build_scheduler("fifo").name == "fifo"
+    with pytest.raises(ValueError):
+        build_scheduler("lifo")
+
+
+def test_edf_meets_strictly_more_deadlines_than_fifo():
+    """The pinned acceptance burst: a deterministic mixed-deadline burst
+    where arrival order is pessimal, simulated through the exact
+    simulate_dispatch the serve_scheduling bench part runs."""
+    def burst():
+        return [
+            _group("g0", _sreq(0)),                        # no deadline
+            _group("g1", _sreq(1, deadline_at=6.0)),
+            _group("g2", _sreq(2, deadline_at=2.0)),
+            _group("g3", _sreq(3, deadline_at=3.0)),
+            _group("g4", _sreq(4, deadline_at=1.0)),
+            _group("g5", _sreq(5, deadline_at=5.0)),
+        ]
+
+    fifo = simulate_dispatch(burst(), FifoScheduler(), service_s=1.0)
+    edf = simulate_dispatch(
+        burst(), EdfScheduler(default_slack_s=30.0, aging_s=10.0), service_s=1.0
+    )
+    fifo_met = sum(r["met"] for r in fifo)
+    edf_met = sum(r["met"] for r in edf)
+    assert edf_met == 6  # every deadline met under EDF
+    assert fifo_met == 2  # arrival order misses g2/g3/g4/g5
+    assert edf_met > fifo_met
+
+
+# --- batcher integration (fake clock) ---------------------------------------
+
+
+def test_admit_stamps_admitted_at_and_deadline_at():
+    sink, clock = [], FakeClock(10.0)
+    c = AdmissionController(
+        dispatch=lambda k, r: sink.append(r), clock=clock, max_group_size=3
+    )
+    r = _req(0)
+    r.deadline_ms = 500.0
+    c.admit(r)
+    assert r.admitted_at == 10.0
+    assert r.deadline_at == 10.5
+    r2 = _req(1)
+    c.admit(r2)
+    assert r2.admitted_at == 10.0 and r2.deadline_at is None
+
+
+def test_take_ready_returns_scheduler_order_across_keys():
+    sink, clock = [], FakeClock()
+    c = AdmissionController(
+        dispatch=lambda k, r: None, clock=clock, max_group_size=1,
+        scheduler=EdfScheduler(aging_s=0.0),
+    )
+    late, soon = _req(0, bucket="a"), _req(1, bucket="b")
+    late.deadline_ms, soon.deadline_ms = 9000.0, 1000.0
+    c.admit(late)  # arrives first, deadline later
+    c.admit(soon)
+    groups = c.take_ready(now=0.0)
+    assert [k[1] for k, _ in groups] == ["b", "a"]
+
+
+def test_batcher_cancel_from_buffer_and_ready():
+    sink, clock = [], FakeClock()
+    c = AdmissionController(
+        dispatch=lambda k, r: None, clock=clock, max_group_size=2
+    )
+    a, b, x = _req(0, bucket="a"), _req(1, bucket="a"), _req(2, bucket="b")
+    c.admit(a)
+    c.admit(b)  # fills the ("resnet18","a") group -> ready
+    c.admit(x)  # still coalescing in its buffer
+    assert c.depth() == 3
+    got = c.cancel("r2")  # from the open buffer
+    assert got is x and c.depth() == 2
+    got = c.cancel("r0")  # from a ready group (group survives with r1)
+    assert got is a and c.depth() == 1
+    assert c.cancel("r0") is None  # already gone
+    groups = c.take_ready(now=float("inf"))
+    assert [[r.id for r in reqs] for _, reqs in groups] == [["r1"]]
+
+
+# --- request parsing --------------------------------------------------------
+
+
+def test_parse_request_priority_and_deadline_validation():
+    base = {"feature_type": "resnet18", "video_path": "/v.mp4"}
+    ok = parse_request(dict(base, priority=7, deadline_ms=250), "http")
+    assert ok.priority == 7 and ok.deadline_ms == 250.0
+    assert parse_request(dict(base), "http").priority == 0
+    for bad in ({"priority": -1}, {"priority": 10}, {"priority": True},
+                {"priority": "3"}, {"deadline_ms": 0}, {"deadline_ms": -5},
+                {"deadline_ms": True}, {"deadline_ms": "100"}):
+        with pytest.raises(BadRequest):
+            parse_request(dict(base, **bad), "http")
+
+
+def test_parse_spool_name_hints():
+    assert parse_spool_name("job") == {}
+    assert parse_spool_name("job.p7") == {"priority": 7}
+    assert parse_spool_name("job.d500") == {"deadline_ms": 500.0}
+    assert parse_spool_name("clip.p2.d1500") == {
+        "priority": 2, "deadline_ms": 1500.0,
+    }
+    # not hints: part of the name
+    assert parse_spool_name("v1.part2") == {}
+
+
+# --- supervisor units (fake clock) ------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=clock)
+    assert b.state() == "closed" and b.allow_request()
+    assert b.record_failure() is False  # 1/2
+    assert b.state() == "closed"
+    assert b.record_failure() is True  # 2/2 -> open
+    assert b.state() == "open" and not b.allow_request()
+    assert 0.0 < b.retry_after_s() <= 10.0
+    assert b.try_probe() is False  # still open
+    clock.t = 10.0
+    assert b.state() == "half_open"
+    assert b.allow_request()
+    assert b.try_probe() is True
+    assert b.try_probe() is False  # single probe slot
+    assert not b.allow_request()  # probe in flight
+    b.record_failure()  # probe failed -> reopen
+    assert b.state() == "open"
+    clock.t = 20.0
+    assert b.try_probe() is True
+    b.record_success()
+    assert b.state() == "closed" and b.allow_request()
+    assert b.snapshot()["opens"] == 2
+
+
+def test_watchdog_inline_and_timeout():
+    w = Watchdog(timeout_s=0.0)
+    assert w.run(lambda: 42) == 42  # inline, unbounded
+    with pytest.raises(ValueError):
+        w.run(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    w = Watchdog(timeout_s=0.05)
+    assert w.run(lambda: "fast") == "fast"
+    with pytest.raises(GroupTimeout):
+        w.run(lambda: time.sleep(0.5))
+    assert w.timeouts() == 1
+    assert faults.classify_error(GroupTimeout("late")) == "transient"
+
+
+# --- daemon: expired / cancelled paths (inline drain, fake clock) -----------
+
+
+def test_expired_request_terminal_path(tmp_path, serve_videos):
+    clock = FakeClock()
+    d, _ = _fake_daemon(tmp_path, serve_videos, clock)
+    d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+              "id": "exp-0", "deadline_ms": 100}, source="local")
+    d.submit({"feature_type": "resnet18", "video_path": serve_videos[1],
+              "id": "ok-0"}, source="local")
+    clock.t = 1.0  # past exp-0's 0.1 s budget before anything dispatches
+    _drain_inline(d)
+    exp = d.tracker.get("exp-0")
+    assert exp["state"] == "expired"
+    assert "deadline_ms" in exp["message"]
+    assert d.tracker.get("ok-0")["state"] == "done"
+    s = faults.merge_manifest(d.tracker.results_dir)
+    assert s["expired"] == 1 and s["done"] == 1
+    assert s["videos"]["request:exp-0"]["status"] == "expired"
+    d.shutdown()
+
+
+def test_cancel_queued_request(tmp_path, serve_videos):
+    d, _ = _daemon(tmp_path, serve_videos, max_group_size=8)
+    d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+              "id": "c-0"}, source="local")
+    rec = d.cancel("c-0")
+    assert rec["state"] == "cancelled"
+    assert d.batcher.depth() == 0
+    assert d.cancel("nope") is None
+    again = d.cancel("c-0")  # already terminal: record stands
+    assert again["state"] == "cancelled" and "cancel_requested" not in again
+    s = faults.merge_manifest(d.tracker.results_dir)
+    assert s["cancelled"] == 1
+    d.shutdown()
+
+
+def test_cancel_after_group_left_queue_honored_at_boundary(tmp_path, serve_videos):
+    d, _ = _daemon(tmp_path, serve_videos, max_group_size=2)
+    for i, rid in enumerate(("b-0", "b-1")):
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[i],
+                  "id": rid}, source="local")
+    groups = d.batcher.take_ready(now=float("inf"))  # dispatcher pulled it
+    assert len(groups) == 1
+    rec = d.cancel("b-0")  # too late for the queue: cancel-requested
+    assert rec.get("cancel_requested") is True
+    d.batcher._run_group(groups[0])  # the boundary check
+    assert d.tracker.get("b-0")["state"] == "cancelled"
+    assert d.tracker.get("b-1")["state"] == "done"
+    assert not d._cancel_pending  # consumed at the boundary
+    d.shutdown()
+
+
+def test_http_delete_cancel_endpoint(tmp_path, serve_videos):
+    # long coalescing wait so the request stays queued until we cancel
+    d, _ = _daemon(tmp_path, serve_videos, port=0, max_batch_wait_ms=60000,
+                   max_group_size=8)
+    d.start()
+    try:
+        url = f"http://127.0.0.1:{d.http_port}"
+        body = json.dumps({"feature_type": "resnet18",
+                           "video_path": serve_videos[0],
+                           "id": "h-0", "priority": 3}).encode()
+        req = urllib.request.Request(
+            f"{url}/v1/extract", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 202
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/requests/h-0", method="DELETE"), timeout=10) as resp:
+            assert resp.status == 200
+            assert json.load(resp)["state"] == "cancelled"
+        # repeating the DELETE is idempotent: 200 with the same record
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/requests/h-0", method="DELETE"), timeout=10) as resp:
+            assert resp.status == 200
+            assert json.load(resp)["state"] == "cancelled"
+        # terminal in another state: too late to cancel -> 409
+        done_req = parse_request({"feature_type": "resnet18",
+                                  "video_path": serve_videos[1],
+                                  "id": "h-done"}, "http")
+        d.tracker.admit(done_req)
+        d.tracker.finish(done_req, "done")
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/requests/h-done", method="DELETE"), timeout=10)
+            assert False, "expected 409"
+        except urllib.error.HTTPError as e:
+            assert e.code == 409 and json.load(e)["state"] == "done"
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/requests/ghost", method="DELETE"), timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        d.shutdown(drain=False)
+
+
+def test_spool_cancel_file_removes_unadmitted_request(tmp_path, serve_videos):
+    d, _ = _daemon(tmp_path, serve_videos,
+                   spool_dir=str(tmp_path / "spool"), max_batch_wait_ms=60000)
+    spool = tmp_path / "spool"
+    w = SpoolWatcher(d, str(spool), poll_s=0.05)  # creates the spool dir
+    (spool / "s-0.json").write_text(json.dumps(
+        {"feature_type": "resnet18", "video_path": serve_videos[0], "id": "s-0"}
+    ))
+    (spool / "s-0.cancel").write_text("")
+    assert w.poll_once() == 0  # cancelled before admission
+    assert not (spool / "s-0.json").exists()
+    assert not (spool / "s-0.cancel").exists()
+    assert d.tracker.get("s-0")["state"] == "cancelled"
+    d.shutdown(drain=False)
+
+
+# --- spool deferral backoff -------------------------------------------------
+
+
+class _BouncingDaemon:
+    """Stub daemon whose submit raises a scripted backpressure error."""
+
+    def __init__(self, exc):
+        self.exc = exc
+        self.calls = 0
+
+    def submit(self, payload, source):
+        self.calls += 1
+        if self.exc is not None:
+            raise self.exc
+
+
+def test_spool_queue_full_defers_with_backoff(tmp_path):
+    spool = tmp_path / "spool"
+    clock = FakeClock()
+    stub = _BouncingDaemon(QueueFull("full"))
+    w = SpoolWatcher(stub, str(spool), poll_s=0.5, clock=clock)
+    (spool / "a.json").write_text(json.dumps({"feature_type": "resnet18",
+                                              "video_path": "/v.mp4"}))
+    assert w.poll_once() == 0
+    assert stub.calls == 1
+    assert (spool / "a.json").exists()  # un-claimed
+    # deferred: re-polling at the same instant must NOT re-claim (the
+    # old behavior was a tight claim/rename spin)
+    assert w.poll_once() == 0
+    assert stub.calls == 1
+    # past the jittered backoff the file is retried
+    clock.t = faults.backoff_delay(1, base=0.5, key="a.json") + 0.001
+    stub.exc = None
+    assert w.poll_once() == 1
+    assert stub.calls == 2
+    assert not (spool / "a.json").exists()
+
+
+def test_spool_breaker_open_defers_but_keeps_scanning(tmp_path):
+    spool = tmp_path / "spool"
+    clock = FakeClock()
+
+    class OneModelDown:
+        def __init__(self):
+            self.seen = []
+
+        def submit(self, payload, source):
+            self.seen.append(payload["feature_type"])
+            if payload["feature_type"] == "resnet18":
+                raise ModelUnavailable("resnet18", 5.0)
+
+    stub = OneModelDown()
+    w = SpoolWatcher(stub, str(spool), poll_s=0.5, clock=clock)
+    (spool / "a.json").write_text(json.dumps({"feature_type": "resnet18",
+                                              "video_path": "/v.mp4"}))
+    (spool / "b.json").write_text(json.dumps({"feature_type": "clip",
+                                              "video_path": "/v.mp4"}))
+    assert w.poll_once() == 1  # b admitted despite a's open breaker
+    assert stub.seen == ["resnet18", "clip"]
+    assert (spool / "a.json").exists()
+    assert w.poll_once() == 1 - 1  # a still deferred, nothing else to do
+    assert stub.seen == ["resnet18", "clip"]
+
+
+def test_spool_filename_hints_reach_payload(tmp_path):
+    spool = tmp_path / "spool"
+
+    class Capture:
+        def __init__(self):
+            self.payloads = []
+
+        def submit(self, payload, source):
+            self.payloads.append(payload)
+
+    stub = Capture()
+    w = SpoolWatcher(stub, str(spool), poll_s=0.5)
+    (spool / "clip.p7.d500.json").write_text(json.dumps(
+        {"feature_type": "resnet18", "video_path": "/v.mp4"}
+    ))
+    # payload fields win over filename hints
+    (spool / "other.p2.json").write_text(json.dumps(
+        {"feature_type": "resnet18", "video_path": "/v.mp4", "priority": 9}
+    ))
+    assert w.poll_once() == 2
+    by_prio = sorted(stub.payloads, key=lambda p: p["priority"])
+    assert by_prio[0]["priority"] == 7 and by_prio[0]["deadline_ms"] == 500.0
+    assert by_prio[1]["priority"] == 9 and "deadline_ms" not in by_prio[1]
+
+
+# --- breaker + watchdog through the daemon ----------------------------------
+
+
+def test_breaker_opens_healthz_reflects_and_probe_recovers(tmp_path, serve_videos):
+    """The acceptance path: injected extractor death opens the breaker,
+    /healthz (daemon.status) reflects it, and a half-open probe recovers
+    the model — daemon never restarts, extractor rebuilds exactly once."""
+    clock = FakeClock()
+    d, Toy = _fake_daemon(
+        tmp_path, serve_videos, clock,
+        fault_inject="extractor:error:2",  # second group on each build dies
+        breaker_threshold=1, breaker_cooldown_s=10.0,
+    )
+    def one(rid, vid):
+        d.submit({"feature_type": "resnet18", "video_path": vid, "id": rid},
+                 source="local")
+        _drain_inline(d)
+        return d.tracker.get(rid)
+
+    assert one("w-0", serve_videos[0])["state"] == "done"
+    assert Toy.built == 1
+    bad = one("w-1", serve_videos[1])  # injected extractor death
+    assert bad["state"] == "failed" and "injected" in bad["message"]
+    st = d.status()
+    assert st["status"] == "degraded"
+    assert st["breakers"]["resnet18"]["state"] == "open"
+    assert st["breakers"]["resnet18"]["retry_after_s"] > 0
+    # while open: admission for THIS model 503s with a rejected record
+    with pytest.raises(ModelUnavailable):
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[2],
+                  "id": "w-2"}, source="local")
+    assert d.tracker.get("w-2")["state"] == "rejected"
+    # cooldown passes -> half-open; the next group is the probe and the
+    # evicted extractor rebuilds (fresh injector counters: call 1 is ok)
+    clock.t = 10.0
+    assert d.status()["breakers"]["resnet18"]["state"] == "half_open"
+    assert one("w-3", serve_videos[3])["state"] == "done"
+    assert Toy.built == 2  # torn down on open, rebuilt for the probe
+    st = d.status()
+    assert st["status"] == "ok"
+    assert st["breakers"]["resnet18"]["state"] == "closed"
+    assert st["breakers"]["resnet18"]["opens"] == 1
+    d.shutdown()
+
+
+def test_breaker_open_sheds_already_queued_requests(tmp_path, serve_videos):
+    clock = FakeClock()
+    d, _ = _fake_daemon(
+        tmp_path, serve_videos, clock,
+        fault_inject="extractor:error:1",  # every group dies
+        breaker_threshold=1, breaker_cooldown_s=10.0,
+    )
+    # two single-member groups in separate buckets: the first opens the
+    # breaker, the second (already admitted) must shed, not run
+    d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+              "id": "q-0", "bucket": "a"}, source="local")
+    d.submit({"feature_type": "resnet18", "video_path": serve_videos[1],
+              "id": "q-1", "bucket": "b"}, source="local")
+    _drain_inline(d)
+    states = {r: d.tracker.get(r)["state"] for r in ("q-0", "q-1")}
+    assert states["q-0"] == "failed"
+    q1 = d.tracker.get("q-1")
+    assert q1["state"] == "failed" and "breaker open" in q1["message"]
+    assert q1["error_class"] == "transient"
+    d.shutdown()
+
+
+def test_watchdog_times_out_hung_group_and_evicts(tmp_path, serve_videos):
+    d, Toy = _daemon(
+        tmp_path, serve_videos,
+        fault_inject="serve_dispatch:hang:1",  # 0.4 s injected hang
+        group_timeout_s=0.1, breaker_threshold=3,
+    )
+    d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+              "id": "hang-0"}, source="local")
+    _drain_inline(d)
+    rec = d.tracker.get("hang-0")
+    assert rec["state"] == "failed"
+    assert rec["error_type"] == "GroupTimeout"
+    assert rec["error_class"] == "transient"
+    # the abandoned worker's extractor was evicted; status counts the hit
+    assert d.pool.feature_types() == []
+    assert d.status()["watchdog_timeouts"] == 1
+    # the next request rebuilds and (injector counters reset on build;
+    # call 1 of serve_dispatch hangs again — wait out the 0.4 s sleep)
+    d.shutdown(drain=False)
+
+
+# --- fault injection on new serve stages ------------------------------------
+
+
+def test_admission_fault_injection(tmp_path, serve_videos):
+    d, _ = _daemon(tmp_path, serve_videos, fault_inject="admission:error:1")
+    with pytest.raises(faults.InjectedTransientError):
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "adm-0"}, source="local")
+    assert d.batcher.depth() == 0  # never admitted
+    d.shutdown(drain=False)
+
+
+def test_tracker_write_fault_degrades_not_loses(tmp_path):
+    faults.install_injector(["tracker_write:error:1"])
+    try:
+        tr = RequestTracker(str(tmp_path))
+        req = _req(0)
+        tr.admit(req)
+        out = tr.finish(req, "done")  # result write dies; finish survives
+        assert out["state"] == "done"
+        assert tr.get("r0")["state"] == "done"  # in-memory record answers
+        assert not os.path.exists(os.path.join(tr.results_dir, "r0.json"))
+        events = [r for r in faults.iter_manifest_records(tr.results_dir)
+                  if r.get("event") == "result_write_failed"]
+        assert len(events) == 1 and events[0]["request"] == "r0"
+    finally:
+        faults.install_injector(None)
+
+
+# --- crash recovery + retention ---------------------------------------------
+
+
+def test_reconcile_requeues_spool_and_fails_http(tmp_path):
+    root, spool = str(tmp_path / "out"), str(tmp_path / "spool")
+    t1 = RequestTracker(root)
+    http_req = ExtractionRequest(feature_type="resnet18", video_path="/a.mp4",
+                                 id="rh", source="http")
+    spool_req = ExtractionRequest(feature_type="resnet18", video_path="/b.mp4",
+                                  id="rs", source="spool", priority=4,
+                                  deadline_ms=2000.0)
+    done_req = ExtractionRequest(feature_type="resnet18", video_path="/c.mp4",
+                                 id="rd", source="http")
+    t1.admit(http_req)
+    t1.admit(spool_req)
+    t1.admit(done_req)
+    t1.dispatched(http_req, group_size=1)
+    t1.finish(done_req, "done")
+    # "kill": a new tracker (fresh process) reconciles the old manifest
+    t2 = RequestTracker(root)
+    got = t2.reconcile(spool_dir=spool)
+    assert got == {"requeued": 1, "interrupted": 1}
+    assert t2.get("rh")["state"] == "failed"
+    assert t2.get("rh")["error_class"] == "interrupted"
+    assert t2.get("rd")["state"] == "done"  # untouched
+    with open(os.path.join(spool, "rs.json"), "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    assert payload == {"feature_type": "resnet18", "video_path": "/b.mp4",
+                       "id": "rs", "priority": 4, "deadline_ms": 2000.0}
+    # idempotent: a second restart has nothing left to reconcile
+    t3 = RequestTracker(root)
+    assert t3.reconcile(spool_dir=spool) == {"requeued": 0, "interrupted": 0}
+
+
+def test_kill9_then_restart_reaches_terminal_states(tmp_path, serve_videos):
+    """The acceptance crash: SIGKILL a process that left one request
+    dispatched and one spool request queued; a restarted daemon must
+    give every request a durable disposition and bound _requests/."""
+    out = str(tmp_path / "out")
+    script = (
+        "import os, signal\n"
+        "from video_features_tpu.serve.lifecycle import (\n"
+        "    ExtractionRequest, RequestTracker)\n"
+        f"tr = RequestTracker({out!r})\n"
+        "h = ExtractionRequest(feature_type='resnet18', video_path='/a.mp4',\n"
+        "                      id='k-http', source='http')\n"
+        "s = ExtractionRequest(feature_type='resnet18', video_path='/b.mp4',\n"
+        "                      id='k-spool', source='spool')\n"
+        "d = ExtractionRequest(feature_type='resnet18', video_path='/c.mp4',\n"
+        "                      id='k-done', source='http')\n"
+        "tr.admit(h); tr.admit(s); tr.admit(d)\n"
+        "tr.dispatched(h, group_size=2)\n"
+        "tr.dispatched(s, group_size=2)\n"
+        "tr.finish(d, 'done')\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", script], cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": repo_root + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        capture_output=True, timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+    # restart: daemon __init__ reconciles, then sweeps to the bound
+    d, _ = _daemon(tmp_path, serve_videos,
+                   spool_dir=str(tmp_path / "spool"), max_request_records=1)
+    assert d.recovered == {"requeued": 1, "interrupted": 1}
+    assert d.tracker.get("k-http")["state"] == "failed"
+    assert d.tracker.get("k-http")["error_class"] == "interrupted"
+    assert os.path.exists(str(tmp_path / "spool" / "k-spool.json"))
+    # every request is durably dispositioned in the folded manifest
+    s = faults.merge_manifest(d.tracker.results_dir)
+    assert s["videos"]["request:k-http"]["status"] == "failed"
+    assert s["videos"]["request:k-done"]["status"] == "done"
+    assert s["videos"]["request:k-spool"]["status"] == "requeued"
+    # and the retention bound holds for result files
+    results = [n for n in os.listdir(d.tracker.results_dir)
+               if n.endswith(".json")]
+    assert len(results) <= 1
+    d.shutdown(drain=False)
+
+
+def test_retention_sweep_ttl_and_count_bound(tmp_path):
+    tr = RequestTracker(str(tmp_path))
+    now = time.time()
+    for i in range(5):
+        req = _req(i)
+        tr.admit(req)
+        tr.finish(req, "done")
+    # age r0/r1 past a 100 s TTL
+    for rid in ("r0", "r1"):
+        path = os.path.join(tr.results_dir, f"{rid}.json")
+        os.utime(path, (now - 500, now - 500))
+        tr._records[rid]["finished_ts"] = now - 500
+    pruned = tr.sweep(ttl_s=100.0, max_records=2, now=now)
+    assert pruned >= 2
+    left = sorted(n for n in os.listdir(tr.results_dir) if n.endswith(".json"))
+    assert len(left) == 2  # TTL killed 2, count bound killed 1 more
+    assert "r0.json" not in left and "r1.json" not in left
+    # in-memory map obeys the same bound
+    with tr._lock:
+        live = [r for r in tr._records.values() if r.get("state") == "done"]
+    assert len(live) <= 2
+    # live (non-terminal) records are never swept
+    q = _req(9)
+    tr.admit(q)
+    tr.sweep(ttl_s=0.000001, max_records=1, now=now + 1000)
+    assert tr.get("r9")["state"] == "queued"
+
+
+# --- graftcheck scope (satellite: new modules, zero waivers) ----------------
+
+
+def test_new_serve_modules_in_graftcheck_scope():
+    import fnmatch
+
+    from video_features_tpu.analysis.core import (
+        HOT_MODULE_PATTERNS,
+        THREAD_ROOT_PATTERNS,
+    )
+
+    for rel in ("serve/scheduler.py", "serve/supervisor.py"):
+        assert any(fnmatch.fnmatch(rel, p) for p in HOT_MODULE_PATTERNS)
+        assert any(fnmatch.fnmatch(rel, p) for p in THREAD_ROOT_PATTERNS)
+    # zero waivers: neither new module asks graftcheck to look away
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("video_features_tpu/serve/scheduler.py",
+                "video_features_tpu/serve/supervisor.py"):
+        with open(os.path.join(pkg, rel), "r", encoding="utf-8") as fh:
+            assert "graftcheck:" not in fh.read()

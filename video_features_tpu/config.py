@@ -616,6 +616,27 @@ class ServeConfig:
     max_batch_wait_ms: float = 50.0
     max_group_size: int = 8
     max_queue: int = 256
+    # cross-key dispatch scheduling (serve/scheduler.py): EDF with
+    # priority tiers and aging by default; "fifo" is the A/B baseline.
+    # default_slack_ms is the effective deadline assigned to requests
+    # that declare none; aging_ms is one priority-tier boost per that
+    # much queue wait (0 disables aging)
+    scheduler: str = "edf"
+    default_slack_ms: float = 30000.0
+    aging_ms: float = 10000.0
+    # supervision (serve/supervisor.py): bound on one group's extraction
+    # wall time (0 = unbounded), and the per-feature-type circuit
+    # breaker (open after `threshold` consecutive group-level failures,
+    # half-open probe after `cooldown_s`)
+    group_timeout_s: float = 0.0
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
+    # retention for <output>/_requests/: terminal records older than the
+    # TTL or beyond the count bound are pruned every retention_sweep_s
+    # (0 disables the background sweeper; startup still sweeps once)
+    request_ttl_s: float = 86400.0
+    max_request_records: int = 10000
+    retention_sweep_s: float = 60.0
     # warmup preflight specs, each "<feature_type>:<W>x<H>"
     warmup: List[str] = field(default_factory=list)
     warmup_only: bool = False
@@ -673,6 +694,38 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
     g.add_argument("--max_queue", type=int, default=256,
                    help="admission bound: requests admitted but not yet "
                         "terminal; past it new requests get 503/rejected")
+    g.add_argument("--scheduler", choices=("edf", "fifo"), default="edf",
+                   help="cross-key dispatch order: earliest-effective-"
+                        "deadline-first with priority tiers and aging "
+                        "(default), or plain arrival order")
+    g.add_argument("--default_slack_ms", type=float, default=30000.0,
+                   help="effective deadline assigned to requests that "
+                        "declare no deadline_ms (EDF ranking only; "
+                        "never expires a request)")
+    g.add_argument("--aging_ms", type=float, default=10000.0,
+                   help="one priority-tier boost per this much queue "
+                        "wait, so low-priority work cannot starve "
+                        "(0 disables aging)")
+    g.add_argument("--group_timeout_s", type=float, default=0.0,
+                   help="watchdog bound on one group's extraction wall "
+                        "time; on timeout the group fails transient and "
+                        "the extractor is rebuilt (0 = unbounded)")
+    g.add_argument("--breaker_threshold", type=int, default=3,
+                   help="consecutive group-level failures that open a "
+                        "feature type's circuit breaker (503 for that "
+                        "model only)")
+    g.add_argument("--breaker_cooldown_s", type=float, default=30.0,
+                   help="seconds an open breaker waits before admitting "
+                        "one half-open probe group")
+    g.add_argument("--request_ttl_s", type=float, default=86400.0,
+                   help="terminal request records older than this are "
+                        "pruned from <output>/_requests/")
+    g.add_argument("--max_request_records", type=int, default=10000,
+                   help="keep at most this many terminal request "
+                        "records (oldest pruned first)")
+    g.add_argument("--retention_sweep_s", type=float, default=60.0,
+                   help="how often the retention sweeper runs "
+                        "(0 disables it; startup still sweeps once)")
     g.add_argument("--warmup", action="append", default=None,
                    metavar="FEATURE_TYPE:WxH",
                    help="pre-build the fused executable for this "
@@ -703,6 +756,15 @@ def parse_serve_args(argv: Optional[Sequence[str]] = None) -> ServeConfig:
         max_batch_wait_ms=args.max_batch_wait_ms,
         max_group_size=args.max_group_size,
         max_queue=args.max_queue,
+        scheduler=args.scheduler,
+        default_slack_ms=args.default_slack_ms,
+        aging_ms=args.aging_ms,
+        group_timeout_s=args.group_timeout_s,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        request_ttl_s=args.request_ttl_s,
+        max_request_records=args.max_request_records,
+        retention_sweep_s=args.retention_sweep_s,
         warmup=list(args.warmup or []),
         warmup_only=warmup_only,
     )
@@ -725,6 +787,24 @@ def sanity_check_serve(scfg: ServeConfig) -> ServeConfig:
         raise ValueError(f"max_batch_wait_ms must be >= 0, got {scfg.max_batch_wait_ms}")
     if scfg.spool_poll_s <= 0:
         raise ValueError(f"spool_poll_s must be > 0, got {scfg.spool_poll_s}")
+    if scfg.scheduler not in ("edf", "fifo"):
+        raise ValueError(f"scheduler must be 'edf' or 'fifo', got {scfg.scheduler!r}")
+    if scfg.default_slack_ms <= 0:
+        raise ValueError(f"default_slack_ms must be > 0, got {scfg.default_slack_ms}")
+    if scfg.aging_ms < 0:
+        raise ValueError(f"aging_ms must be >= 0, got {scfg.aging_ms}")
+    if scfg.group_timeout_s < 0:
+        raise ValueError(f"group_timeout_s must be >= 0, got {scfg.group_timeout_s}")
+    if scfg.breaker_threshold < 1:
+        raise ValueError(f"breaker_threshold must be >= 1, got {scfg.breaker_threshold}")
+    if scfg.breaker_cooldown_s < 0:
+        raise ValueError(f"breaker_cooldown_s must be >= 0, got {scfg.breaker_cooldown_s}")
+    if scfg.request_ttl_s <= 0:
+        raise ValueError(f"request_ttl_s must be > 0, got {scfg.request_ttl_s}")
+    if scfg.max_request_records < 1:
+        raise ValueError(f"max_request_records must be >= 1, got {scfg.max_request_records}")
+    if scfg.retention_sweep_s < 0:
+        raise ValueError(f"retention_sweep_s must be >= 0, got {scfg.retention_sweep_s}")
     scfg.warmup_pairs()  # raises naming any bad spec
     if scfg.warmup_only and not scfg.warmup:
         raise ValueError("serve warmup needs at least one --warmup FEATURE_TYPE:WxH")

@@ -37,6 +37,7 @@ utils/utils.py:312) which is a unit bug; the correct milliseconds-per-frame
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Iterator, List, Optional, Tuple
 
@@ -49,6 +50,9 @@ from video_features_tpu.runtime.faults import CorruptVideoError, DecodeTimeout
 
 _DECODER = "auto"  # 'auto' | 'cv2' | 'native'; set once from the config
 _DECODE_TIMEOUT: Optional[float] = None  # seconds per reader; set from the config
+# BaseExtractor.__init__ sets the timeout, and the serve daemon builds
+# extractors from its dispatcher thread — rebinds must hold this lock
+_CONFIG_LOCK = threading.Lock()
 
 
 def set_decoder(name: str) -> None:
@@ -68,7 +72,8 @@ def set_decode_timeout(seconds: Optional[float]) -> None:
     choice: the readers are constructed deep inside samplers that don't
     thread config through."""
     global _DECODE_TIMEOUT
-    _DECODE_TIMEOUT = float(seconds) if seconds else None
+    with _CONFIG_LOCK:
+        _DECODE_TIMEOUT = float(seconds) if seconds else None
 
 
 def _resolve(decoder: Optional[str]) -> str:

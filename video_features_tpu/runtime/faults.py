@@ -49,7 +49,15 @@ from typing import Any, Dict, List, Optional, Sequence
 MANIFEST_DIRNAME = "_manifest"
 SUMMARY_BASENAME = "summary.json"
 
-STAGES = ("decode", "prepare", "dispatch", "sink")
+# decode/prepare/dispatch/sink are the batch extraction pipeline;
+# admission/serve_dispatch/extractor/tracker_write are serve-daemon
+# stages (ISSUE 8): request admission, the group body around the
+# extractor call, the resident extractor itself (breaker/teardown
+# coverage), and the durable result write.
+STAGES = (
+    "decode", "prepare", "dispatch", "sink",
+    "admission", "serve_dispatch", "extractor", "tracker_write",
+)
 KINDS = ("error", "corrupt", "hang", "oom", "compile", "kill")
 # how long an injected 'hang' sleeps; tests pair it with a shorter
 # --decode_timeout so the REAL deadline check fires, not a mock
@@ -246,6 +254,10 @@ class FaultInjector:
 
 
 _INJECTOR: Optional[FaultInjector] = None
+# the serve daemon (re)installs the injector on every extractor build,
+# which can happen from the dispatcher thread — the rebind needs a lock
+# even though fire() reads the reference atomically
+_INJECTOR_LOCK = threading.Lock()
 
 
 def install_injector(specs: Optional[Sequence[str]]) -> None:
@@ -254,7 +266,8 @@ def install_injector(specs: Optional[Sequence[str]]) -> None:
     wins, which is exactly the one-run-per-process CLI lifecycle."""
     global _INJECTOR
     parsed = parse_fault_specs(specs)
-    _INJECTOR = FaultInjector(parsed) if parsed else None
+    with _INJECTOR_LOCK:
+        _INJECTOR = FaultInjector(parsed) if parsed else None
 
 
 def fire(stage: str) -> None:
@@ -410,8 +423,10 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
             continue
         cur = videos.setdefault(key, {"status": None})
         cur["attempts"] = max(int(cur.get("attempts") or 0), int(r.get("attempts") or 0))
-        terminal = status in ("done", "failed", "rejected")
-        if terminal or cur["status"] not in ("done", "failed", "rejected"):
+        terminal = status in ("done", "failed", "rejected", "expired", "cancelled")
+        if terminal or cur["status"] not in (
+            "done", "failed", "rejected", "expired", "cancelled"
+        ):
             cur["status"] = status
             # 'span' links a failure to its interval in
             # _telemetry/spans-*.jsonl (runtime/telemetry.py)
@@ -422,7 +437,7 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
                 elif field in cur and terminal:
                     del cur[field]
     counts = {"done": 0, "failed": 0, "skipped": 0, "retry": 0,
-              "rejected": 0, "other": 0}
+              "rejected": 0, "expired": 0, "cancelled": 0, "other": 0}
     for v in videos.values():
         counts[v["status"] if v["status"] in counts else "other"] += 1
     worker_deaths = [e for e in events if e.get("event") == "worker_death"]
@@ -433,6 +448,8 @@ def merge_manifest(output_root: str) -> Optional[Dict[str, Any]]:
         "failed": counts["failed"],
         "skipped": counts["skipped"],
         "rejected": counts["rejected"],
+        "expired": counts["expired"],
+        "cancelled": counts["cancelled"],
         "retries": retries,
         "warnings": warnings,
         "events": events,
@@ -476,6 +493,10 @@ def format_summary(summary: Dict[str, Any]) -> str:
     ]
     if summary.get("rejected"):
         parts.insert(2, f"{summary['rejected']} rejected")
+    if summary.get("expired"):
+        parts.append(f"{summary['expired']} expired")
+    if summary.get("cancelled"):
+        parts.append(f"{summary['cancelled']} cancelled")
     if summary["warnings"]:
         parts.append(f"{len(summary['warnings'])} warning(s)")
     if summary["worker_deaths"]:

@@ -11,7 +11,12 @@ dispatches while a lone request waits at most one deadline.
 
 One dispatcher thread executes groups serially (the Arachne framing:
 one resident scheduler multiplexing model stages over a fixed chip
-pool); sources admit concurrently from their own threads. The admission
+pool); WHICH ready group runs next is the pluggable scheduler's call
+(serve/scheduler.py, ISSUE 8): EDF across keys with priority tiers and
+aging by default, FIFO as the A/B baseline. Admission stamps each
+request's ``admitted_at``/``deadline_at`` on this controller's clock so
+scheduler ranks and fake-clock tests share one time base. Sources admit
+concurrently from their own threads. The admission
 queue is bounded (``max_queue``, counting every request admitted but
 not yet terminal) — past the bound :meth:`admit` raises
 :class:`QueueFull`, which the HTTP source turns into a 503 and the
@@ -29,10 +34,11 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict, deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from video_features_tpu.serve.lifecycle import ExtractionRequest
+from video_features_tpu.serve.scheduler import EdfScheduler
 
 Key = Tuple[str, str]
 Group = Tuple[Key, List[ExtractionRequest]]
@@ -60,6 +66,7 @@ class AdmissionController:
         max_queue: int = 256,
         clock: Callable[[], float] = time.monotonic,
         metrics: Any = None,
+        scheduler: Optional[EdfScheduler] = None,
     ) -> None:
         self._dispatch = dispatch
         self.max_group_size = max(int(max_group_size), 1)
@@ -67,13 +74,17 @@ class AdmissionController:
         self.max_queue = max(int(max_queue), 1)
         self._clock = clock
         self._metrics = metrics
+        self._scheduler = scheduler if scheduler is not None else EdfScheduler()
         self._cond = threading.Condition()
         # key -> open coalescing buffer; insertion-ordered so expiry
         # sweeps oldest-first (a buffer's deadline is set when its FIRST
         # member arrives and never extended by later ones)
         self._buffers: "OrderedDict[Key, List[ExtractionRequest]]" = OrderedDict()
         self._deadlines: Dict[Key, float] = {}
-        self._ready: Deque[Group] = deque()
+        # ready groups in the order they became ready; the scheduler
+        # picks ACROSS this list at each dispatch, index = arrival
+        # tie-break, so FIFO scheduling degenerates to the old deque
+        self._ready: List[Group] = []
         self._depth = 0  # admitted, not yet handed back as terminal
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -93,6 +104,12 @@ class AdmissionController:
                     f"admission queue full ({self._depth}/{self.max_queue})"
                 )
             self._depth += 1
+            # absolute scheduling times on THIS controller's clock: the
+            # scheduler's ranks and the dispatch-time expiry check both
+            # read these, never the wall clock
+            req.admitted_at = self._clock()
+            if req.deadline_ms is not None:
+                req.deadline_at = req.admitted_at + req.deadline_ms / 1000.0
             key = req.key()
             buf = self._buffers.setdefault(key, [])
             buf.append(req)
@@ -108,6 +125,37 @@ class AdmissionController:
     def depth(self) -> int:
         with self._cond:
             return self._depth
+
+    def cancel(self, request_id: str) -> Optional[ExtractionRequest]:
+        """Pull one still-queued request out of the admission queue —
+        open coalescing buffer or ready group — returning it so the
+        caller records the terminal ``cancelled`` state. None when the
+        request is not here (already dispatched, or unknown): dispatched
+        requests are the daemon's cancel-requested set, checked at the
+        group boundary."""
+        with self._cond:
+            for key, buf in list(self._buffers.items()):
+                for i, r in enumerate(buf):
+                    if r.id == request_id:
+                        buf.pop(i)
+                        if not buf:
+                            del self._buffers[key]
+                            self._deadlines.pop(key, None)
+                        self._depth -= 1
+                        self._gauge_locked()
+                        self._cond.notify_all()
+                        return r
+            for gi, (key, reqs) in enumerate(self._ready):
+                for i, r in enumerate(reqs):
+                    if r.id == request_id:
+                        reqs.pop(i)
+                        if not reqs:
+                            self._ready.pop(gi)
+                        self._depth -= 1
+                        self._gauge_locked()
+                        self._cond.notify_all()
+                        return r
+        return None
 
     # -- deadline sweep (pure given `now`; lock held by callers) --------
 
@@ -126,11 +174,12 @@ class AdmissionController:
 
     def take_ready(self, now: Optional[float] = None) -> List[Group]:
         """Drain every group ready at ``now`` (full groups plus buffers
-        whose deadline has passed). The dispatcher loop's pop — and the
+        whose deadline has passed), in scheduler dispatch order. The
         deterministic surface the fake-clock tests drive directly."""
         with self._cond:
-            self._flush_expired_locked(self._clock() if now is None else now)
-            out = list(self._ready)
+            now = self._clock() if now is None else now
+            self._flush_expired_locked(now)
+            out = self._scheduler.order(self._ready, now)
             self._ready.clear()
             return out
 
@@ -153,9 +202,10 @@ class AdmissionController:
             with self._cond:
                 group: Optional[Group] = None
                 while group is None:
-                    self._flush_expired_locked(self._clock())
+                    now = self._clock()
+                    self._flush_expired_locked(now)
                     if self._ready:
-                        group = self._ready.popleft()
+                        group = self._ready.pop(self._scheduler.pick(self._ready, now))
                         break
                     if self._closed:
                         return

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -43,10 +44,18 @@ from video_features_tpu.runtime import telemetry as telemetry_mod
 from video_features_tpu.runtime.telemetry import Telemetry
 from video_features_tpu.serve.batcher import AdmissionController, Key, QueueFull
 from video_features_tpu.serve.lifecycle import (
+    TERMINAL_STATES,
     BadRequest,
     ExtractionRequest,
     RequestTracker,
     parse_request,
+)
+from video_features_tpu.serve.scheduler import build_scheduler
+from video_features_tpu.serve.supervisor import (
+    CircuitBreaker,
+    GroupTimeout,
+    ModelUnavailable,
+    Watchdog,
 )
 
 
@@ -149,6 +158,19 @@ class ExtractorPool:
         with self._lock:
             return sorted(self._extractors)
 
+    def evict(self, feature_type: str) -> None:
+        """Tear one resident extractor down (breaker opened, or a
+        watchdog-abandoned worker may still hold its model state); the
+        next :meth:`get` rebuilds from scratch through the same path —
+        warm compile cache, fresh everything else."""
+        with self._lock:
+            ext = self._extractors.pop(feature_type, None)
+        if ext is not None:
+            try:
+                ext.telemetry.close()
+            except Exception:  # noqa: BLE001 - eviction must finish
+                pass
+
     def close(self) -> None:
         with self._lock:
             exts = list(self._extractors.values())
@@ -164,10 +186,21 @@ class ServeDaemon:
     request tracker. Construct, :meth:`start`, then :meth:`shutdown`
     (drains by default)."""
 
-    def __init__(self, scfg: ServeConfig, build: Callable[..., Any] = build_extractor) -> None:
+    def __init__(
+        self,
+        scfg: ServeConfig,
+        build: Callable[..., Any] = build_extractor,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self.scfg = scfg
         self.cfg = scfg.extraction
+        self.clock = clock
         os.makedirs(self.cfg.output_path, exist_ok=True)
+        # serve-path stages (admission/serve_dispatch/tracker_write) fire
+        # before any extractor exists; install the injector now — each
+        # extractor build reinstalls the same specs (extract/base.py),
+        # which only resets the counters
+        faults.install_injector(self.cfg.fault_inject)
         # the daemon's own telemetry: request spans, admission gauge,
         # request counters, and the heartbeat line (which now reports
         # live queue depth — see Telemetry.heartbeat_line)
@@ -177,27 +210,61 @@ class ServeDaemon:
             heartbeat_s=float(self.cfg.heartbeat_s or 0.0),
         )
         self.tracker = RequestTracker(self.cfg.output_path, telemetry=self.telemetry)
+        # crash recovery BEFORE any source can admit: requests a dead
+        # process left queued/dispatched reach a durable state (spool
+        # files re-queued, HTTP requests failed 'interrupted')
+        self.recovered = self.tracker.reconcile(scfg.spool_dir)
+        if any(self.recovered.values()):
+            print(f"serve: recovered prior run: {self.recovered['requeued']} "
+                  f"requeued, {self.recovered['interrupted']} interrupted")
+        self.tracker.sweep(scfg.request_ttl_s, scfg.max_request_records)
         self.pool = ExtractorPool(self.cfg, scfg.max_group_size, build=build)
         self.batcher = AdmissionController(
             dispatch=self._dispatch_group,
             max_group_size=scfg.max_group_size,
             max_batch_wait_s=scfg.max_batch_wait_ms / 1000.0,
             max_queue=scfg.max_queue,
+            clock=clock,
             metrics=self.telemetry.metrics,
+            scheduler=build_scheduler(
+                scfg.scheduler,
+                default_slack_s=scfg.default_slack_ms / 1000.0,
+                aging_s=scfg.aging_ms / 1000.0,
+            ),
         )
+        self.watchdog = Watchdog(scfg.group_timeout_s)
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._cancel_pending: set = set()
         self._http_server: Any = None
         self._http_thread: Any = None
         self._spool: Any = None
+        self._sweep_thread: Optional[threading.Thread] = None
+        self._sweep_stop = threading.Event()
         self._lock = threading.Lock()
         self._started = False
+
+    def _breaker(self, feature_type: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(feature_type)
+            if b is None:
+                b = CircuitBreaker(
+                    threshold=self.scfg.breaker_threshold,
+                    cooldown_s=self.scfg.breaker_cooldown_s,
+                    clock=self.clock,
+                )
+                self._breakers[feature_type] = b
+            return b
 
     # -- the request path ------------------------------------------------
 
     def submit(self, payload: Dict[str, Any], source: str) -> Dict[str, Any]:
         """Parse, validate, lifecycle-admit, and queue one request.
-        Raises :class:`BadRequest` (caller -> 400 / rejected record) or
-        :class:`QueueFull` (caller -> 503 / spool backpressure); on
-        QueueFull the request is already recorded ``rejected``."""
+        Raises :class:`BadRequest` (caller -> 400 / rejected record),
+        :class:`QueueFull` (caller -> 503 / spool backpressure; the
+        request is already recorded ``rejected``), or
+        :class:`ModelUnavailable` (this feature type's breaker is open:
+        HTTP -> 503 with Retry-After and a ``rejected`` record, spool ->
+        defer the file untouched)."""
         req = parse_request(payload, source)
         if req.feature_type not in self.scfg.feature_types:
             raise BadRequest(
@@ -206,6 +273,15 @@ class ServeDaemon:
             )
         if not os.path.exists(req.video_path):
             raise BadRequest(f"video_path does not exist: {req.video_path}")
+        faults.fire("admission")
+        breaker = self._breaker(req.feature_type)
+        if not breaker.allow_request():
+            exc = ModelUnavailable(req.feature_type, breaker.retry_after_s())
+            if req.source != "spool":
+                # terminal record for HTTP/local callers; the spool file
+                # is its own durable record and just waits out the open
+                self.tracker.reject(req, str(exc))
+            raise exc
         rec = self.tracker.admit(req)
         try:
             self.batcher.admit(req)
@@ -222,66 +298,209 @@ class ServeDaemon:
     def _dispatch_group(self, key: Key, requests: List[ExtractionRequest]) -> None:
         """One coalesced group -> one resident-extractor run over the
         group's videos. Runs on the dispatcher thread; every outcome —
-        including a build/dispatch crash — lands as a terminal record on
-        every member request."""
+        including a build/dispatch crash, a watchdog timeout, or a
+        breaker that opened after admission — lands as a terminal record
+        on every member request.
+
+        The group boundary is where scheduling decisions become final:
+        cancel-requested members leave as ``cancelled`` and members whose
+        deadline already passed leave as ``expired`` BEFORE the group
+        touches the chip — an expired request must not burn compute."""
         feature_type = key[0]
         try:
-            ext = self.pool.get(feature_type)
-        except Exception as exc:  # noqa: BLE001 - model build failed: fail the group
-            msg = f"extractor build failed: {type(exc).__name__}: {exc}"
-            traceback.print_exc()
-            for r in requests:
-                self.tracker.finish(
-                    r, "failed", error_class=faults.classify_error(exc),
-                    error_type=type(exc).__name__, message=msg,
-                )
-            return
-        for r in requests:
-            self.tracker.dispatched(r, group_size=len(requests))
-        # module-level telemetry hooks (decode frame counters, bucket
-        # notes) follow the extractor whose group is on the chip now
-        telemetry_mod.set_current(ext.telemetry)
-        try:
-            with ext.telemetry.span(
-                "request",
-                group_size=len(requests),
-                requests=[r.id for r in requests],
-                feature_type=feature_type,
-                bucket=key[1],
-            ):
-                ext.run_paths([r.video_path for r in requests])
-        except Exception as exc:  # noqa: BLE001 - loop-level crash: fail the group
-            traceback.print_exc()
+            live = self._boundary_filter(requests)
+            if not live:
+                return
+            breaker = self._breaker(feature_type)
+            probing = breaker.try_probe()
+            if not probing and breaker.state() != "closed":
+                # opened between admission and dispatch (or another
+                # group holds the probe slot): nothing here may run
+                self._shed_unavailable(live, feature_type, breaker)
+                return
+            try:
+                ext = self.pool.get(feature_type)
+                if probing:
+                    # the probe group must prove the model END TO END
+                    # before real traffic rides it: re-warm through the
+                    # declared warmup pairs first
+                    self._rewarm(ext, feature_type)
+            except Exception as exc:  # noqa: BLE001 - build/re-warm failed: fail the group
+                msg = f"extractor build failed: {type(exc).__name__}: {exc}"
+                traceback.print_exc()
+                for r in live:
+                    self.tracker.finish(
+                        r, "failed", error_class=faults.classify_error(exc),
+                        error_type=type(exc).__name__, message=msg,
+                    )
+                if breaker.record_failure():
+                    self.pool.evict(feature_type)
+                return
+            for r in live:
+                self.tracker.dispatched(r, group_size=len(live))
+            # module-level telemetry hooks (decode frame counters, bucket
+            # notes) follow the extractor whose group is on the chip now
+            telemetry_mod.set_current(ext.telemetry)
+
+            def body() -> None:
+                faults.fire("serve_dispatch")  # hang: the watchdog's prey
+                faults.fire("extractor")  # error/oom: resident model death
+                with ext.telemetry.span(
+                    "request",
+                    group_size=len(live),
+                    requests=[r.id for r in live],
+                    feature_type=feature_type,
+                    bucket=key[1],
+                ):
+                    ext.run_paths([r.video_path for r in live])
+
+            try:
+                self.watchdog.run(body)
+            except Exception as exc:  # noqa: BLE001 - loop-level crash: fail the group
+                traceback.print_exc()
+                outcomes = ext.manifest.take()
+                err = {
+                    "error_class": faults.classify_error(exc),
+                    "error_type": type(exc).__name__,
+                    "message": str(exc)[:500],
+                }
+                for r in live:
+                    got = outcomes.get(r.video_path)
+                    if got is not None and got["status"] == "done":
+                        self._finish_done(r, ext)
+                    else:
+                        self.tracker.finish(r, "failed", **err)
+                # group-level failure: one breaker tick; a timed-out
+                # worker is abandoned, so its extractor must never be
+                # reused even if the breaker stays closed
+                if breaker.record_failure() or isinstance(exc, GroupTimeout):
+                    self.pool.evict(feature_type)
+                return
+            breaker.record_success()
             outcomes = ext.manifest.take()
-            err = {
-                "error_class": faults.classify_error(exc),
-                "error_type": type(exc).__name__,
-                "message": str(exc)[:500],
-            }
-            for r in requests:
+            for r in live:
                 got = outcomes.get(r.video_path)
-                if got is not None and got["status"] == "done":
+                if got is None:
+                    self.tracker.finish(
+                        r, "failed", error_class="permanent",
+                        message="no terminal manifest record for this video",
+                    )
+                elif got["status"] == "done":
                     self._finish_done(r, ext)
                 else:
-                    self.tracker.finish(r, "failed", **err)
-            return
-        outcomes = ext.manifest.take()
+                    self.tracker.finish(
+                        r, "failed",
+                        error_class=got.get("error_class"),
+                        error_type=got.get("error_type"),
+                        message=got.get("message"),
+                    )
+        finally:
+            with self._lock:
+                self._cancel_pending.difference_update(r.id for r in requests)
+
+    def _boundary_filter(
+        self, requests: List[ExtractionRequest]
+    ) -> List[ExtractionRequest]:
+        """The pre-dispatch sweep: cancel-requested members -> cancelled,
+        past-deadline members -> expired; the rest run."""
+        now = self.clock()
+        with self._lock:
+            pending = set(self._cancel_pending)
+        live: List[ExtractionRequest] = []
         for r in requests:
-            got = outcomes.get(r.video_path)
-            if got is None:
+            if r.id in pending:
                 self.tracker.finish(
-                    r, "failed", error_class="permanent",
-                    message="no terminal manifest record for this video",
+                    r, "cancelled", error_class="cancelled",
+                    message="cancelled before dispatch",
                 )
-            elif got["status"] == "done":
-                self._finish_done(r, ext)
+            elif r.deadline_at is not None and now > r.deadline_at:
+                self.tracker.finish(
+                    r, "expired", error_class="expired",
+                    message=f"deadline_ms={r.deadline_ms:g} passed "
+                            f"{now - r.deadline_at:.3f}s before dispatch",
+                )
+            else:
+                live.append(r)
+        return live
+
+    def _shed_unavailable(
+        self,
+        requests: List[ExtractionRequest],
+        feature_type: str,
+        breaker: CircuitBreaker,
+    ) -> None:
+        """The breaker opened after these requests were admitted: spool
+        requests go back to their durable home, others fail transient."""
+        retry = breaker.retry_after_s()
+        for r in requests:
+            if r.source == "spool" and self.scfg.spool_dir:
+                self.tracker.requeue(r, self.scfg.spool_dir)
             else:
                 self.tracker.finish(
-                    r, "failed",
-                    error_class=got.get("error_class"),
-                    error_type=got.get("error_type"),
-                    message=got.get("message"),
+                    r, "failed", error_class="transient",
+                    message=f"model {feature_type!r} unavailable (circuit "
+                            f"breaker open); retry in {retry:.1f}s",
                 )
+
+    def _rewarm(self, ext: Any, feature_type: str) -> None:
+        """Half-open probe preflight: drive this feature type's declared
+        ``--warmup`` pairs through the rebuilt extractor so the probe
+        proves weights + executables, not just construction. No declared
+        pairs -> the probe group itself is the only proof (still end to
+        end). Raises when any warm clip fails."""
+        from video_features_tpu.utils.synth import synth_video
+
+        pairs = [p for p in self.scfg.warmup_pairs() if p[0] == feature_type]
+        if not pairs:
+            return
+        wdir = os.path.join(self.cfg.output_path, "_warmup")
+        os.makedirs(wdir, exist_ok=True)
+        paths: List[str] = []
+        for i, (_ft, w, h) in enumerate(pairs):
+            clip = os.path.join(wdir, f"warm-{w}x{h}.mp4")
+            if not os.path.exists(clip):
+                synth_video(clip, n_frames=8, width=w, height=h, seed=i)
+            paths.append(clip)
+        ext.run_paths(paths)
+        outcomes = ext.manifest.take()
+        bad = [p for p in paths
+               if outcomes.get(p, {}).get("status") != "done"]
+        if bad:
+            raise RuntimeError(
+                f"probe re-warm failed for {len(bad)}/{len(paths)} clip(s)"
+            )
+
+    def cancel(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """DELETE /v1/requests/<id> (and spool ``.cancel`` files): a
+        still-queued request leaves the queue as terminal ``cancelled``;
+        a dispatched one is marked cancel-requested (honored at the next
+        group boundary it is still queued at — extraction already on the
+        chip is never interrupted). Returns the record (with
+        ``cancel_requested`` set when not yet terminal), or None for an
+        unknown id."""
+        rec = self.tracker.get(request_id)
+        if rec is None:
+            return None
+        if rec.get("state") in TERMINAL_STATES:
+            return rec
+        req = self.batcher.cancel(request_id)
+        if req is not None:
+            return self.tracker.finish(
+                req, "cancelled", error_class="cancelled",
+                message="cancelled while queued",
+            )
+        with self._lock:
+            self._cancel_pending.add(request_id)
+        # the dispatcher may have finished it between our two looks; the
+        # boundary sweep discards stale ids, so only re-read the record
+        rec = self.tracker.get(request_id) or {"id": request_id}
+        if rec.get("state") in TERMINAL_STATES:
+            with self._lock:
+                self._cancel_pending.discard(request_id)
+            return rec
+        out = dict(rec)
+        out["cancel_requested"] = True
+        return out
 
     def _finish_done(self, req: ExtractionRequest, ext: Any) -> None:
         files = expected_output_files(
@@ -339,6 +558,11 @@ class ServeDaemon:
         if self.scfg.warmup:
             self.warmup()
         self.batcher.start()
+        if self.scfg.retention_sweep_s > 0:
+            self._sweep_thread = threading.Thread(
+                target=self._sweep_loop, name="serve-retention", daemon=True
+            )
+            self._sweep_thread.start()
         if self.scfg.spool_dir is not None:
             from video_features_tpu.serve.sources import SpoolWatcher
 
@@ -360,21 +584,41 @@ class ServeDaemon:
     def http_port(self) -> Optional[int]:
         return self._http_server.server_address[1] if self._http_server else None
 
+    def _sweep_loop(self) -> None:
+        while not self._sweep_stop.wait(self.scfg.retention_sweep_s):
+            try:
+                self.tracker.sweep(
+                    self.scfg.request_ttl_s, self.scfg.max_request_records
+                )
+            except Exception:  # noqa: BLE001 - retention must not kill serving
+                traceback.print_exc()
+
     def status(self) -> Dict[str, Any]:
-        """The /healthz body: queue depth, per-state request counts, and
-        which models are warm."""
+        """The /healthz body: queue depth, per-state request counts,
+        which models are warm, and every circuit breaker's state (a
+        breaker exists once its model has seen traffic)."""
+        with self._lock:
+            breakers = {ft: b.snapshot() for ft, b in sorted(self._breakers.items())}
+        degraded = any(b["state"] != "closed" for b in breakers.values())
         return {
-            "status": "ok",
+            "status": "degraded" if degraded else "ok",
             "queue_depth": self.batcher.depth(),
             "max_queue": self.scfg.max_queue,
             "requests": self.tracker.counts(),
             "serving": list(self.scfg.feature_types),
             "warm": self.pool.feature_types(),
+            "scheduler": self.scfg.scheduler,
+            "breakers": breakers,
+            "watchdog_timeouts": self.watchdog.timeouts(),
         }
 
     def shutdown(self, drain: bool = True) -> None:
-        """Stop sources, drain (default) or reject the backlog, close
-        telemetry, and write the final summary.json."""
+        """Stop sources, drain (default) or durably disposition the
+        backlog, close telemetry, and write the final summary.json.
+        ``drain=False`` must still leave every undispatched request with
+        a durable record: spool requests go back to the spool (the next
+        daemon re-admits them under the same id), others are ``failed``
+        interrupted — never silently stranded."""
         if self._http_server is not None:
             self._http_server.shutdown()
             self._http_server.server_close()
@@ -385,8 +629,18 @@ class ServeDaemon:
         if self._spool is not None:
             self._spool.stop()
             self._spool = None
+        if self._sweep_thread is not None:
+            self._sweep_stop.set()
+            self._sweep_thread.join()
+            self._sweep_thread = None
         for req in self.batcher.close(drain=drain):
-            self.tracker.reject(req, "daemon shutdown before dispatch")
+            if req.source == "spool" and self.scfg.spool_dir:
+                self.tracker.requeue(req, self.scfg.spool_dir)
+            else:
+                self.tracker.finish(
+                    req, "failed", error_class="interrupted",
+                    message="daemon shutdown before dispatch; resubmit to retry",
+                )
         self.pool.close()
         self.telemetry.close()
         try:

@@ -6,7 +6,9 @@ identity: two users asking for the same clip are two requests, and each
 one must end in a queryable terminal state. States:
 
     queued -> dispatched -> done | failed
-    queued -> rejected                      (backpressure / bad input)
+    queued -> rejected                (backpressure / bad input / breaker)
+    queued -> expired                 (deadline passed before dispatch)
+    queued | dispatched -> cancelled  (DELETE /v1/requests/<id>, .cancel)
 
 Every transition is appended to a :class:`~video_features_tpu.runtime.
 faults.RunManifest` rooted at ``<output>/_requests`` (so the extraction
@@ -24,6 +26,7 @@ No jax imports; everything here runs on source/HTTP threads.
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
 import os
 import re
@@ -32,15 +35,25 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from video_features_tpu.runtime import faults as faults_mod
 from video_features_tpu.runtime.faults import RunManifest
 
 REQUESTS_DIRNAME = "_requests"
 
-# queued/dispatched are transitional; done/failed/rejected are terminal
-# (merge_manifest treats all three as terminal when folding the request
-# manifest, so a restart never resurrects a rejected request as live).
-REQUEST_STATES = ("queued", "dispatched", "done", "failed", "rejected")
-TERMINAL_STATES = ("done", "failed", "rejected")
+# queued/dispatched are transitional; done/failed/rejected/expired/
+# cancelled are terminal (merge_manifest treats all five as terminal
+# when folding the request manifest, so a restart never resurrects a
+# rejected/expired/cancelled request as live). 'deferred' and 'requeued'
+# are manifest-only notes: the request left THIS process but its spool
+# file is the durable copy that re-submits it.
+REQUEST_STATES = (
+    "queued", "dispatched", "done", "failed", "rejected", "expired", "cancelled",
+)
+TERMINAL_STATES = ("done", "failed", "rejected", "expired", "cancelled")
+
+# non-terminal manifest statuses that need NO reconciliation after a
+# crash: the spool file still exists and re-submits the request itself
+_SPOOL_SAFE_STATES = ("deferred", "requeued")
 
 # request ids become result filenames: constrain them so a hostile id
 # can never traverse out of _requests/ (the HTTP source accepts ids)
@@ -71,6 +84,14 @@ class ExtractionRequest:
     bucket: str = DEFAULT_BUCKET
     source: str = "local"  # http | spool | warmup | local
     received_ts: float = dataclasses.field(default_factory=time.time)
+    # scheduling hints (ISSUE 8): tier 0..9 (higher = more urgent) and a
+    # latency budget in ms from admission; the batcher stamps the
+    # absolute admitted_at/deadline_at on ITS clock at admit time, so
+    # the fake-clock tests and the EDF ranks share one time base
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+    admitted_at: Optional[float] = None
+    deadline_at: Optional[float] = None
 
     def key(self) -> Tuple[str, str]:
         """The admission-control key: same-(feature_type, bucket)
@@ -103,6 +124,23 @@ def parse_request(payload: Dict[str, Any], source: str) -> ExtractionRequest:
         if not isinstance(bucket, str) or len(bucket) > 32:
             raise BadRequest("bad 'bucket': expected a short string like '640x480'")
         kw["bucket"] = bucket
+    priority = payload.get("priority")
+    if priority is not None:
+        if isinstance(priority, bool) or not isinstance(priority, int) \
+                or not 0 <= priority <= 9:
+            raise BadRequest(
+                "bad 'priority': expected an integer 0..9 (higher = more urgent)"
+            )
+        kw["priority"] = priority
+    deadline_ms = payload.get("deadline_ms")
+    if deadline_ms is not None:
+        if isinstance(deadline_ms, bool) or not isinstance(deadline_ms, (int, float)) \
+                or not 0 < float(deadline_ms) <= 7 * 24 * 3600 * 1000:
+            raise BadRequest(
+                "bad 'deadline_ms': expected a positive number of milliseconds "
+                "(latency budget from admission)"
+            )
+        kw["deadline_ms"] = float(deadline_ms)
     return ExtractionRequest(**kw)
 
 
@@ -139,6 +177,10 @@ class RequestTracker:
             "source": req.source,
             "received_ts": round(req.received_ts, 4),
         }
+        if req.priority:
+            rec["priority"] = int(req.priority)
+        if req.deadline_ms is not None:
+            rec["deadline_ms"] = float(req.deadline_ms)
         with self._lock:
             if req.id in self._records:
                 raise BadRequest(f"duplicate request id {req.id!r}")
@@ -152,10 +194,17 @@ class RequestTracker:
             if token is not None:
                 with self._lock:
                     self._spans[req.id] = token
+        # the queued record carries the full resubmittable payload: it
+        # is what reconcile() rebuilds a request from after a crash
+        extra: Dict[str, Any] = {}
+        if req.priority:
+            extra["priority"] = int(req.priority)
+        if req.deadline_ms is not None:
+            extra["deadline_ms"] = float(req.deadline_ms)
         self.manifest.record(
             f"request:{req.id}", "queued",
             feature_type=req.feature_type, video_path=req.video_path,
-            bucket=req.bucket, source=req.source,
+            bucket=req.bucket, source=req.source, **extra,
         )
         return dict(rec)
 
@@ -210,7 +259,16 @@ class RequestTracker:
             if k in out
         }
         self.manifest.record(f"request:{req.id}", status, **extra)
-        self._write_result(out)
+        try:
+            self._write_result(out)
+        except OSError as exc:
+            # degraded durability, not a lost outcome: the manifest line
+            # above already landed, the in-memory record still answers
+            # queries, and the event makes the gap auditable
+            self.manifest.event(
+                "result_write_failed", request=req.id,
+                error_type=type(exc).__name__, message=str(exc)[:200],
+            )
         return out
 
     def forget(self, req: ExtractionRequest) -> None:
@@ -234,6 +292,167 @@ class RequestTracker:
         return self.finish(
             req, "rejected", error_class="rejected", message=reason
         )
+
+    def requeue(self, req: ExtractionRequest, spool_dir: str) -> None:
+        """Durably re-queue a spool-sourced request that this process
+        cannot finish (shutdown with an undrained backlog, or crash
+        recovery): write its payload back into the spool — atomically,
+        like any producer — so the next daemon re-admits it under the
+        same id, then drop the live record. The manifest gains a
+        'requeued' line: non-terminal by design, because the spool file
+        is now the durable owner of the request."""
+        payload: Dict[str, Any] = {
+            "feature_type": req.feature_type,
+            "video_path": req.video_path,
+            "id": req.id,
+        }
+        if req.bucket != DEFAULT_BUCKET:
+            payload["bucket"] = req.bucket
+        if req.priority:
+            payload["priority"] = int(req.priority)
+        if req.deadline_ms is not None:
+            # the latency budget restarts on re-admission: a requeued
+            # request gets a fresh window, not an instant expiry
+            payload["deadline_ms"] = float(req.deadline_ms)
+        os.makedirs(spool_dir, exist_ok=True)
+        tmp = os.path.join(spool_dir, f".requeue-{req.id}.{uuid.uuid4().hex[:6]}.tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, os.path.join(spool_dir, f"{req.id}.json"))
+        with self._lock:
+            self._records.pop(req.id, None)
+            token = self._spans.pop(req.id, None)
+        if token is not None:
+            token.finish(state="requeued")
+        self._count("requests_requeued")
+        self.manifest.record(f"request:{req.id}", "requeued")
+
+    # -- crash recovery + retention -------------------------------------
+
+    def reconcile(self, spool_dir: Optional[str] = None) -> Dict[str, int]:
+        """Startup pass over prior processes' request manifests: every
+        request a dead daemon left non-terminal (queued/dispatched)
+        reaches a durable state — re-queued into the spool when it came
+        from one (and a spool is configured), else marked ``failed`` /
+        interrupted with a result record the status endpoint can serve.
+        Runs before any source opens, so every folded record belongs to
+        a previous process."""
+        folded: Dict[str, Dict[str, Any]] = {}
+        for r in faults_mod.iter_manifest_records(self.results_dir):
+            key = r.get("video")
+            if not isinstance(key, str) or not key.startswith("request:"):
+                continue
+            rid = key[len("request:"):]
+            cur = folded.setdefault(rid, {})
+            status = r.get("status")
+            if status:
+                cur["state"] = status
+            for f in ("feature_type", "video_path", "bucket", "source",
+                      "priority", "deadline_ms"):
+                if r.get(f) is not None:
+                    cur.setdefault(f, r[f])
+        requeued = interrupted = 0
+        for rid, rec in sorted(folded.items()):
+            state = rec.get("state")
+            if state in TERMINAL_STATES or state in _SPOOL_SAFE_STATES:
+                continue
+            req = ExtractionRequest(
+                feature_type=str(rec.get("feature_type") or ""),
+                video_path=str(rec.get("video_path") or ""),
+                id=rid,
+                bucket=str(rec.get("bucket") or DEFAULT_BUCKET),
+                source=str(rec.get("source") or "local"),
+                priority=int(rec.get("priority") or 0),
+                deadline_ms=rec.get("deadline_ms"),
+            )
+            if req.source == "spool" and spool_dir:
+                self.requeue(req, spool_dir)
+                requeued += 1
+            else:
+                self.finish(
+                    req, "failed", error_class="interrupted",
+                    message=f"daemon terminated while request was {state}; "
+                            "resubmit to retry",
+                )
+                interrupted += 1
+        return {"requeued": requeued, "interrupted": interrupted}
+
+    def sweep(
+        self,
+        ttl_s: float,
+        max_records: int,
+        now: Optional[float] = None,
+    ) -> int:
+        """TTL/size-bounded retention: prune terminal result files (and
+        prior-run manifest event files) older than ``ttl_s``, keep at
+        most ``max_records`` result files (oldest dropped first), and
+        age the in-memory map the same way — ``_requests/`` stops
+        growing without bound under steady traffic. Returns how many
+        records were pruned."""
+        now = time.time() if now is None else now
+        pruned = 0
+        results: List[Tuple[float, str]] = []
+        try:
+            names = os.listdir(self.results_dir)
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.results_dir, name)
+            try:
+                if os.path.isfile(path):
+                    results.append((os.stat(path).st_mtime, path))
+            except OSError:
+                continue
+        results.sort()  # oldest first
+        survivors: List[str] = []
+        for mtime, path in results:
+            if ttl_s > 0 and now - mtime > ttl_s:
+                pruned += self._unlink(path)
+            else:
+                survivors.append(path)
+        if max_records > 0 and len(survivors) > max_records:
+            for path in survivors[: len(survivors) - max_records]:
+                pruned += self._unlink(path)
+        if ttl_s > 0:
+            # prior-run manifest logs: after reconcile() every request
+            # they describe is terminal (and result-file-backed), so an
+            # aged-out events file carries no live state
+            for path in glob.glob(
+                os.path.join(self.results_dir, faults_mod.MANIFEST_DIRNAME,
+                             "events-*.jsonl")
+            ):
+                if path == self.manifest.path:
+                    continue
+                try:
+                    if now - os.stat(path).st_mtime > ttl_s:
+                        pruned += self._unlink(path)
+                except OSError:
+                    continue
+        with self._lock:
+            terminal = sorted(
+                (rec.get("finished_ts", 0.0), rid)
+                for rid, rec in self._records.items()
+                if rec.get("state") in TERMINAL_STATES
+            )
+            drop = [rid for ts, rid in terminal if ttl_s > 0 and now - ts > ttl_s]
+            keep = len(terminal) - len(drop)
+            if max_records > 0 and keep > max_records:
+                dropped = set(drop)
+                drop += [rid for ts, rid in terminal
+                         if rid not in dropped][: keep - max_records]
+            for rid in drop:
+                self._records.pop(rid, None)
+        return pruned + len(drop)
+
+    @staticmethod
+    def _unlink(path: str) -> int:
+        try:
+            os.unlink(path)
+            return 1
+        except OSError:
+            return 0
 
     # -- queries --------------------------------------------------------
 
@@ -270,6 +489,7 @@ class RequestTracker:
 
     def _write_result(self, rec: Dict[str, Any]) -> None:
         """tmp + rename so a status reader never sees a torn record."""
+        faults_mod.fire("tracker_write")
         os.makedirs(self.results_dir, exist_ok=True)
         path = os.path.join(self.results_dir, f"{rec['id']}.json")
         tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:6]}.tmp"

@@ -1,0 +1,165 @@
+"""Cross-key dispatch ordering for the serve daemon (ISSUE 8 tentpole).
+
+PR 7's dispatcher was FIFO over ready groups: whichever (feature_type,
+bucket) buffer filled or timed out first ran first, regardless of which
+request was about to miss its deadline. This module owns the dispatch
+ORDER across keys (the VirtualFlow framing: the scheduler, not the
+extractor, decides what reaches the chip next), implementing
+earliest-effective-deadline-first with priority tiers and
+anti-starvation aging:
+
+- every request carries an optional ``deadline_ms`` (stamped to an
+  absolute ``deadline_at`` on the admission clock when admitted) and a
+  ``priority`` tier (0..9, higher = more urgent);
+- a ready group's *effective deadline* is the earliest deadline of its
+  members; deadline-less members count as ``admitted_at +
+  default_slack_s``, so best-effort traffic still ages toward the front
+  instead of starving behind an endless deadline stream;
+- groups rank by ``(effective priority tier desc, effective deadline
+  asc, arrival)``; a group's tier is its most urgent member's, boosted
+  one tier per ``aging_s`` its oldest member has waited — so a tier-0
+  backlog can never be starved by a steady tier-9 stream (after at most
+  ``9 * aging_s`` of waiting, any group reaches the top tier).
+
+Everything here is a pure function of ``(groups, now)``: the batcher
+calls :meth:`pick` under its own lock with its own (injectable) clock,
+and the fake-clock tier-1 tests plus the ``serve_scheduling`` bench
+part drive the same code with synthetic groups — no threads, no sleeps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+# a group, as the batcher stores it: ((feature_type, bucket), [requests]).
+# Duplicated shape (not imported from batcher) to keep this module
+# import-light and cycle-free — batcher imports the scheduler.
+Group = Tuple[Tuple[str, str], List[Any]]
+
+# aging can promote a group at most this many tiers past its declared
+# priority: enough to clear the 0..9 request range with room to spare,
+# finite so an infinitely-old group (or a now=inf drain sweep) ranks
+# deterministically instead of overflowing
+MAX_AGING_BOOST = 16
+
+SCHEDULER_NAMES = ("edf", "fifo")
+
+
+class EdfScheduler:
+    """Earliest-effective-deadline-first across (feature_type, bucket)
+    keys, with priority tiers and aging. Stateless between calls: rank
+    is recomputed at each pick so aging reflects *dispatch-time* wait,
+    not admission-time."""
+
+    name = "edf"
+
+    def __init__(self, default_slack_s: float = 30.0, aging_s: float = 10.0) -> None:
+        self.default_slack_s = max(float(default_slack_s), 0.0)
+        self.aging_s = float(aging_s)
+
+    # -- rank components -------------------------------------------------
+
+    def effective_deadline(self, requests: Sequence[Any], now: float) -> float:
+        """Earliest member deadline; deadline-less members count as
+        ``admitted_at + default_slack_s`` so they participate in EDF
+        instead of sorting last forever."""
+        best: float = float("inf")
+        for r in requests:
+            d = getattr(r, "deadline_at", None)
+            if d is None:
+                t0 = getattr(r, "admitted_at", None)
+                d = (now if t0 is None else t0) + self.default_slack_s
+            if d < best:
+                best = d
+        return now if best == float("inf") else best
+
+    def _aging_boost(self, requests: Sequence[Any], now: float) -> int:
+        if self.aging_s <= 0:
+            return 0
+        oldest = min(
+            (t for r in requests
+             if (t := getattr(r, "admitted_at", None)) is not None),
+            default=None,
+        )
+        if oldest is None:
+            return 0
+        wait = now - oldest
+        if wait >= self.aging_s * MAX_AGING_BOOST:
+            return MAX_AGING_BOOST
+        return int(wait / self.aging_s) if wait > 0 else 0
+
+    def rank(self, group: Group, now: float) -> Tuple[float, float]:
+        """Smaller ranks dispatch first. Priority tier (aged) dominates;
+        effective deadline breaks ties within a tier; callers break
+        remaining ties by arrival order (stable index)."""
+        _key, requests = group
+        tier = max((int(getattr(r, "priority", 0) or 0) for r in requests), default=0)
+        tier += self._aging_boost(requests, now)
+        return (-float(tier), self.effective_deadline(requests, now))
+
+    # -- the batcher's surface -------------------------------------------
+
+    def pick(self, groups: Sequence[Group], now: float) -> int:
+        """Index of the group to dispatch next (``groups`` non-empty;
+        index tie-break = arrival order, since the batcher appends ready
+        groups in the order they became ready)."""
+        return min(range(len(groups)), key=lambda i: (self.rank(groups[i], now), i))
+
+    def order(self, groups: Sequence[Group], now: float) -> List[Group]:
+        """All groups, best-first — the inline-drain and test surface."""
+        idx = sorted(range(len(groups)), key=lambda i: (self.rank(groups[i], now), i))
+        return [groups[i] for i in idx]
+
+
+class FifoScheduler(EdfScheduler):
+    """PR 7's dispatch order (arrival only), kept as the A/B baseline
+    the ``serve_scheduling`` bench part and the EDF-beats-FIFO
+    acceptance test compare against."""
+
+    name = "fifo"
+
+    def rank(self, group: Group, now: float) -> Tuple[float, float]:
+        return (0.0, 0.0)  # callers' index tie-break IS the order
+
+
+def build_scheduler(
+    name: str, default_slack_s: float = 30.0, aging_s: float = 10.0
+) -> EdfScheduler:
+    if name not in SCHEDULER_NAMES:
+        raise ValueError(f"unknown scheduler {name!r} (expected one of {SCHEDULER_NAMES})")
+    cls = FifoScheduler if name == "fifo" else EdfScheduler
+    return cls(default_slack_s=default_slack_s, aging_s=aging_s)
+
+
+def simulate_dispatch(
+    groups: Sequence[Group],
+    scheduler: EdfScheduler,
+    service_s: float,
+    start: float = 0.0,
+) -> List[Dict[str, Any]]:
+    """Deterministic serial-dispatch simulation over ready groups: one
+    group per ``service_s`` tick, ordered by ``scheduler.pick`` at each
+    tick (so aging acts over simulated time). Returns one record per
+    request with its completion time, latency, and whether its deadline
+    was met — shared by the pinned EDF-beats-FIFO tier-1 test and the
+    ``serve_scheduling`` bench part, so the benched policy is exactly
+    the tested one."""
+    pending: List[Group] = list(groups)
+    now = float(start)
+    out: List[Dict[str, Any]] = []
+    while pending:
+        i = scheduler.pick(pending, now)
+        key, requests = pending.pop(i)
+        now += float(service_s)
+        for r in requests:
+            deadline = getattr(r, "deadline_at", None)
+            admitted = getattr(r, "admitted_at", None)
+            out.append({
+                "id": getattr(r, "id", None),
+                "key": key,
+                "priority": int(getattr(r, "priority", 0) or 0),
+                "completed_at": now,
+                "latency_s": now - (start if admitted is None else admitted),
+                "met": deadline is None or now <= deadline,
+            })
+    return out

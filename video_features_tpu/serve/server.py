@@ -4,13 +4,21 @@ Endpoints (the whole surface — this is an admission door, not a web
 framework; anything fancier belongs behind a real proxy):
 
 - ``POST /v1/extract`` — body ``{"feature_type": ..., "video_path": ...,
-  "bucket"?: "WxH", "id"?: ...}``; 202 + the queued lifecycle record,
-  400 on a malformed request (recorded nowhere — it never had an
-  identity), 503 + Retry-After when the bounded admission queue is full
-  (recorded ``rejected``; the client owns the retry).
+  "bucket"?: "WxH", "id"?: ..., "priority"?: 0..9, "deadline_ms"?: N}``;
+  202 + the queued lifecycle record, 400 on a malformed request
+  (recorded nowhere — it never had an identity), 503 + Retry-After when
+  the bounded admission queue is full OR this feature type's circuit
+  breaker is open (recorded ``rejected``; the client owns the retry).
 - ``GET /v1/requests/<id>`` — the lifecycle record (memory, falling back
   to the durable result JSON); 404 for unknown ids.
-- ``GET /healthz`` — queue depth, per-state counts, warm model list.
+- ``DELETE /v1/requests/<id>`` — cancel: 200 + the terminal record when
+  the request was still queued (idempotent: repeating the DELETE of an
+  already-cancelled request is 200 again), 202 + ``cancel_requested``
+  when it is already dispatched (honored at the group boundary), 409 +
+  the record when already terminal in another state (done/failed/
+  rejected/expired — too late to cancel), 404 for unknown ids.
+- ``GET /healthz`` — queue depth, per-state counts, warm model list,
+  scheduler name, per-model circuit-breaker state.
 
 ThreadingHTTPServer: handlers run on per-connection threads, so
 everything they touch (daemon.submit -> tracker/batcher) is lock-guarded
@@ -68,16 +76,42 @@ class ServeHandler(BaseHTTPRequestHandler):
         except BadRequest as exc:
             self._send(400, {"error": str(exc)})
             return
-        except Exception as exc:  # noqa: BLE001 - QueueFull without importing batcher here
-            if type(exc).__name__ == "QueueFull":
+        except Exception as exc:  # noqa: BLE001 - QueueFull/ModelUnavailable without importing serve internals here
+            name = type(exc).__name__
+            if name == "QueueFull":
                 self._send(
                     503,
                     {"error": str(exc), "queue_depth": daemon.batcher.depth()},
                     retry_after=daemon.scfg.max_batch_wait_ms / 1000.0 * 2,
                 )
                 return
+            if name == "ModelUnavailable":
+                self._send(
+                    503,
+                    {"error": str(exc),
+                     "feature_type": getattr(exc, "feature_type", None)},
+                    retry_after=getattr(exc, "retry_after_s", 1.0),
+                )
+                return
             raise
         self._send(202, rec)
+
+    def do_DELETE(self) -> None:  # noqa: N802 - http.server API
+        daemon = self.server.daemon  # type: ignore[attr-defined]
+        prefix = "/v1/requests/"
+        if not self.path.startswith(prefix):
+            self._send(404, {"error": f"no such endpoint: {self.path}"})
+            return
+        rid = self.path[len(prefix):].rstrip("/")
+        rec = daemon.cancel(rid)
+        if rec is None:
+            self._send(404, {"error": f"unknown request id {rid!r}"})
+        elif rec.get("state") == "cancelled":
+            self._send(200, rec)
+        elif rec.get("cancel_requested"):
+            self._send(202, rec)
+        else:  # already terminal: too late to cancel, record stands
+            self._send(409, rec)
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         daemon = self.server.daemon  # type: ignore[attr-defined]

@@ -3,10 +3,14 @@
 Protocol (documented in docs/serving.md): a producer writes a request as
 ``<spool>/<name>.json`` — atomically, via write-to-temp + rename into
 the directory, exactly like the sinks in io/ — with the same schema as
-the HTTP body. The watcher polls (``--spool_poll_s``), claims a file by
-renaming it to ``<name>.json.claimed`` (rename is the mutual exclusion:
-two watchers on one spool can race a file, only one rename wins), then
-submits it:
+the HTTP body. Scheduling hints can ride in the payload
+(``priority``/``deadline_ms``) or, for producers that only control the
+filename, in the name itself: ``<base>.pN.json`` sets priority N and
+``<base>.dMS.json`` sets deadline_ms MS (combined: ``clip.p7.d500.json``
+— payload fields win over filename hints). The watcher polls
+(``--spool_poll_s``), claims a file by renaming it to
+``<name>.json.claimed`` (rename is the mutual exclusion: two watchers on
+one spool can race a file, only one rename wins), then submits it:
 
 - admitted       -> claimed file is deleted; track via the result JSON
                     under ``<output>/_requests/<id>.json``
@@ -14,33 +18,83 @@ submits it:
                     (and, when the payload named an id, a rejected
                     lifecycle record) — poison files must leave the
                     scan path or they re-fail every poll
-- queue full     -> the claim is renamed BACK to ``<name>.json``: the
-                    file system is the retry queue, which is the whole
-                    point of a spool; next poll retries.
+- queue full /   -> the claim is renamed BACK to ``<name>.json``: the
+  breaker open      file system is the retry queue, which is the whole
+                    point of a spool. The un-claimed file is then
+                    *deferred* with jittered exponential backoff
+                    (:func:`~video_features_tpu.runtime.faults.
+                    backoff_delay`) so a full queue or an open breaker
+                    never turns the poll into a tight claim/rename spin.
+
+Cancellation: dropping ``<id>.cancel`` into the spool cancels request
+``<id>`` — an unclaimed ``<id>.json`` is deleted before it is ever
+admitted; otherwise the cancel routes through ``daemon.cancel`` exactly
+like ``DELETE /v1/requests/<id>``. The ``.cancel`` file is consumed
+once handled.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import threading
+import time
 import traceback
-from typing import Any
+from typing import Any, Callable, Dict
 
+from video_features_tpu.runtime import faults as faults_mod
 from video_features_tpu.serve.batcher import QueueFull
 from video_features_tpu.serve.lifecycle import BadRequest
+from video_features_tpu.serve.supervisor import ModelUnavailable
+
+# a deferred file is retried after at most this long no matter how many
+# times it has been deferred — backpressure is expected to clear
+MAX_DEFER_S = 30.0
+
+# filename scheduling hints: trailing .pN / .dMS segments before .json
+_NAME_HINT_RE = re.compile(r"\.(p([0-9])|d([0-9]{1,9}))$")
+
+
+def parse_spool_name(name: str) -> Dict[str, Any]:
+    """Extract ``priority``/``deadline_ms`` hints from a spool filename
+    (without its ``.json`` suffix). Unrecognized segments are simply part
+    of the request name — this never raises."""
+    hints: Dict[str, Any] = {}
+    base = name
+    while True:
+        m = _NAME_HINT_RE.search(base)
+        if m is None:
+            return hints
+        if m.group(2) is not None:
+            hints.setdefault("priority", int(m.group(2)))
+        else:
+            hints.setdefault("deadline_ms", float(m.group(3)))
+        base = base[: m.start()]
 
 
 class SpoolWatcher:
     """Polls a spool directory and feeds ``daemon.submit``. One thread;
     start()/stop(); a single :meth:`poll_once` pass is the deterministic
-    unit the tests drive directly."""
+    unit the tests drive directly (with an injectable clock, so deferral
+    backoff is tested without sleeping)."""
 
-    def __init__(self, daemon: Any, spool_dir: str, poll_s: float = 0.5) -> None:
+    def __init__(
+        self,
+        daemon: Any,
+        spool_dir: str,
+        poll_s: float = 0.5,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self.daemon = daemon
         self.spool_dir = spool_dir
         self.poll_s = max(float(poll_s), 0.01)
+        self._clock = clock
         os.makedirs(spool_dir, exist_ok=True)
+        # name -> (attempts, retry_at): files bounced by backpressure
+        # (queue full / breaker open) are skipped until retry_at — the
+        # jittered re-scan backoff that replaces the old tight spin
+        self._deferred: Dict[str, Any] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread = threading.Thread(
             target=self._loop, name="serve-spool", daemon=True
@@ -62,17 +116,40 @@ class SpoolWatcher:
                 traceback.print_exc()
             self._stop.wait(self.poll_s)
 
+    def _defer(self, name: str, path: str, claimed: str) -> None:
+        """Un-claim and schedule the next attempt: exponential in this
+        file's bounce count, deterministically jittered by name so a
+        burst of deferred files does not re-arrive in lockstep."""
+        try:
+            os.replace(claimed, path)  # un-claim: spool = retry queue
+        except OSError:
+            pass
+        attempts = int(self._deferred.get(name, (0, 0.0))[0]) + 1
+        delay = min(
+            faults_mod.backoff_delay(attempts, base=self.poll_s, key=name),
+            MAX_DEFER_S,
+        )
+        self._deferred[name] = (attempts, self._clock() + delay)
+
     def poll_once(self) -> int:
-        """One scan pass; returns how many files were admitted. Stops
-        early on queue-full — everything left in the directory is
-        naturally deferred to the next poll."""
+        """One scan pass; returns how many files were admitted.
+        ``.cancel`` files are handled first (a cancel racing its request
+        in one scan must win); deferred files are skipped until their
+        backoff expires."""
         try:
             names = sorted(os.listdir(self.spool_dir))
         except OSError:
             return 0
+        now = self._clock()
         admitted = 0
         for name in names:
+            if name.endswith(".cancel"):
+                self._handle_cancel(name)
+        for name in names:
             if not name.endswith(".json"):
+                continue
+            entry = self._deferred.get(name)
+            if entry is not None and now < entry[1]:
                 continue
             path = os.path.join(self.spool_dir, name)
             claimed = path + ".claimed"
@@ -83,16 +160,55 @@ class SpoolWatcher:
             try:
                 with open(claimed, "r", encoding="utf-8") as fh:
                     payload = json.load(fh)
+                if isinstance(payload, dict):
+                    for k, v in parse_spool_name(name[: -len(".json")]).items():
+                        payload.setdefault(k, v)
                 self.daemon.submit(payload, source="spool")
             except QueueFull:
-                os.replace(claimed, path)  # un-claim: spool = retry queue
-                return admitted
+                self._defer(name, path, claimed)
+                return admitted  # the whole queue is full: end the pass
+            except ModelUnavailable:
+                # one model's breaker is open; other files may still be
+                # admissible, so defer this one and keep scanning
+                self._defer(name, path, claimed)
             except (ValueError, BadRequest) as exc:
+                self._deferred.pop(name, None)
                 self._quarantine(claimed, name, exc)
             else:
                 admitted += 1
+                self._deferred.pop(name, None)
                 os.unlink(claimed)
         return admitted
+
+    def _handle_cancel(self, name: str) -> None:
+        """``<id>.cancel``: delete the matching unclaimed ``<id>.json``
+        if it is still here (cancelled before admission — terminal
+        record included), else route through ``daemon.cancel``. The
+        ``.cancel`` file is consumed either way."""
+        rid = name[: -len(".cancel")]
+        cancel_path = os.path.join(self.spool_dir, name)
+        spooled = os.path.join(self.spool_dir, f"{rid}.json")
+        try:
+            os.unlink(spooled)
+        except OSError:
+            rec = self.daemon.cancel(rid)
+            if rec is None:
+                print(f"serve: spool cancel for unknown request {rid!r}")
+        else:
+            self._deferred.pop(f"{rid}.json", None)
+            from video_features_tpu.serve.lifecycle import ExtractionRequest
+
+            self.daemon.tracker.finish(
+                ExtractionRequest(
+                    feature_type="", video_path="", id=rid, source="spool"
+                ),
+                "cancelled", error_class="cancelled",
+                message="cancelled in spool before admission",
+            )
+        try:
+            os.unlink(cancel_path)
+        except OSError:
+            pass
 
     def _quarantine(self, claimed: str, name: str, exc: Exception) -> None:
         bad = os.path.join(self.spool_dir, name + ".bad")
