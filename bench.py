@@ -1032,6 +1032,50 @@ def _sub_telemetry_overhead() -> dict:
     return out
 
 
+def _sub_preflight_overhead() -> dict:
+    """Admission cost of the hostile-media preflight probe (io/probe.py):
+    one container open, header-sanity checks against the resource caps,
+    and one first-frame grab per video — paid once per admitted request
+    (serve) or manifest entry (batch). Measured on a happy-path clip with
+    all three caps armed (the most checks the probe ever runs) and
+    reported in us/video and as a percentage of the r01 CLIP chip
+    headline (3.637 videos/s -> ~275 ms/video), pinning ISSUE 9's <1%
+    budget."""
+    import timeit
+
+    from video_features_tpu.io.probe import ResourceCaps, preflight
+    from video_features_tpu.utils.synth import synth_video
+
+    n = 200
+    out = {}
+    caps = ResourceCaps(
+        max_pixels=3840 * 2160, max_duration_s=3600.0,
+        max_decode_bytes=1 << 36,
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = synth_video(
+            os.path.join(tmp, "probe.mp4"), n_frames=60, width=320, height=240
+        )
+        assert preflight(path, need="video", caps=caps).verdict == "ok"
+        total_s = timeit.timeit(
+            lambda: preflight(path, need="video", caps=caps), number=n
+        )
+        # the header-only variant (no first-frame grab): what spool
+        # re-polls and probe-only callers pay
+        header_s = timeit.timeit(
+            lambda: preflight(path, need="video", caps=caps, first_frame=False),
+            number=n,
+        )
+    per_video_us = total_s / n * 1e6
+    headline_s_per_video = 1.0 / 3.637  # BENCH_r01 chip headline
+    pct = per_video_us / 1e6 / headline_s_per_video * 100.0
+    out["preflight_us_per_video"] = round(per_video_us, 2)
+    out["preflight_header_only_us_per_video"] = round(header_s / n * 1e6, 2)
+    out["preflight_pct_vs_headline"] = round(pct, 4)
+    out["preflight_within_budget"] = pct < 1.0
+    return out
+
+
 def _sub_analysis_overhead() -> dict:
     """Wall-time of a full graftcheck sweep (docs/analysis.md): the
     static-analysis suite is meant to run on every push via
@@ -1215,6 +1259,7 @@ SUB_PARTS = {
     "flash_attention": lambda: bench_flash_attention(),
     "fault_overhead": _sub_fault_overhead,
     "telemetry_overhead": _sub_telemetry_overhead,
+    "preflight_overhead": _sub_preflight_overhead,
     "analysis_overhead": _sub_analysis_overhead,
     "serve_latency": _sub_serve_latency,
     "serve_scheduling": _sub_serve_scheduling,
@@ -1388,6 +1433,10 @@ def main() -> None:
     # same contract for the telemetry spans/metrics bookkeeping (ISSUE 6
     # <1% ceiling, on-minus-off vs the --telemetry off degradation)
     extra.update(_spawn_sub("telemetry_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
+    emit()
+    # admission preflight probe cost (ISSUE 9 <1% budget: one container
+    # open + header checks + a single-frame grab per video, pure host)
+    extra.update(_spawn_sub("preflight_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
     # graftcheck latency budget (pure host: AST only, no device work)
     extra.update(_spawn_sub("analysis_overhead", 120.0, env={"JAX_PLATFORMS": "cpu"}))

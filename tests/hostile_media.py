@@ -1,0 +1,247 @@
+"""Deterministic corrupt-media corpus for the hostile-input tests.
+
+Every file is GENERATED at test time from cv2-written synthetic clips
+plus byte-level surgery — no binary fixtures live in the repo, and no
+ffmpeg is needed. Each generator documents the real-world failure it
+stands in for and was verified against this environment's OpenCV: the
+byte offsets below are structural (RIFF/AVI chunk layout, JPEG SOF0
+markers), not magic numbers for one encoder build.
+
+The corpus is the shared substrate for three test layers:
+
+- probe unit tests (verdict per entry — tests/test_hostile_media.py)
+- batch acceptance (every entry reaches a defined terminal manifest
+  state with zero retries burned on permanents)
+- serve acceptance (every entry reaches a terminal request state over
+  live HTTP and spool; zero breaker openings, zero worker deaths)
+
+Entry expectations are encoded HERE, next to the bytes that cause them,
+so the acceptance loops stay data-driven.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from video_features_tpu.utils.synth import synth_video
+
+
+@dataclass
+class HostileEntry:
+    """One corpus file plus its expected handling.
+
+    probe_verdict: expected io/probe.py verdict for ``need='video'``.
+    batch_terminal: expected manifest status when run through a
+        frame-consuming batch extractor ('done' or 'failed'); None for
+        entries that only make sense under a specific need/cap setup.
+    expect_warnings: substrings that must appear in recorded warnings
+        (probe cautions or decode notes) when the entry goes through.
+    """
+
+    name: str
+    path: str
+    probe_verdict: str
+    batch_terminal: Optional[str] = None
+    reason_contains: Optional[str] = None
+    expect_warnings: List[str] = field(default_factory=list)
+
+
+# -- low-level byte surgery -------------------------------------------
+
+
+def _write_avi_mjpg(
+    path: str, n_frames: int = 60, width: int = 64, height: int = 48,
+    fps: float = 25.0,
+) -> str:
+    """MJPG-in-AVI: every frame is an independent JPEG, so a truncated
+    file still decodes its prefix — the container for salvage vectors
+    (an mp4 with its moov atom at the tail just refuses to open)."""
+    import cv2
+
+    writer = cv2.VideoWriter(
+        path, cv2.VideoWriter_fourcc(*"MJPG"), fps, (width, height)
+    )
+    assert writer.isOpened(), "cv2.VideoWriter could not open MJPG/avi writer"
+    yy, xx = np.mgrid[0:height, 0:width]
+    for t in range(n_frames):
+        frame = np.stack(
+            [(xx + 2 * t) % 256, (yy + t) % 256,
+             np.full((height, width), (t * 4) % 256)],
+            axis=-1,
+        ).astype(np.uint8)
+        writer.write(frame)
+    writer.release()
+    return path
+
+
+def _truncate(src: str, dst: str, frac: float) -> str:
+    data = open(src, "rb").read()
+    with open(dst, "wb") as f:
+        f.write(data[: max(int(len(data) * frac), 1)])
+    return dst
+
+
+def _patch_fps_zero(src: str, dst: str) -> str:
+    """Rewrite the AVI video stream header so fps computes to ~0:
+    strh.dwRate/dwScale is the frame rate, and dwScale=0xFFFFFFF0 with
+    dwRate=1 yields ~4.7e-10 fps — the 'metadata says zero/absent frame
+    rate' class that silently became 25.0 downstream before this PR.
+    Offsets: 'strh' tag, 8 bytes of chunk header, then fccType(4)
+    fccHandler(4) dwFlags(4) wPriority(2) wLanguage(2) dwInitialFrames(4)
+    = 20 bytes to dwScale, 24 to dwRate."""
+    data = bytearray(open(src, "rb").read())
+    i = data.find(b"strh")
+    assert i >= 0, "no strh chunk in generated AVI"
+    struct.pack_into("<I", data, i + 8 + 20, 0xFFFFFFF0)  # dwScale
+    struct.pack_into("<I", data, i + 8 + 24, 1)  # dwRate
+    open(dst, "wb").write(data)
+    return dst
+
+
+def _patch_sof_dims(src: str, dst: str, width: int, height: int) -> str:
+    """Lie about frame dimensions INSIDE every MJPEG frame's SOF0
+    marker (container headers are sanitized away by self-describing
+    JPEG frames, so the lie must live in the bitstream). A 65500x65500
+    claim makes every frame undecodable while the container still opens
+    — the header-lie class the probe's first-frame check exists for.
+    SOF0 layout: ff c0 | len(2) | precision(1) | height(2) | width(2),
+    big-endian."""
+    data = bytearray(open(src, "rb").read())
+    patched = 0
+    j = data.find(b"\xff\xc0")
+    while j >= 0:
+        # guard against \xff\xc0 appearing in entropy-coded data: a real
+        # SOF0 for 3-component MJPEG has len=17 and precision=8
+        if data[j + 2 : j + 5] == b"\x00\x11\x08":
+            struct.pack_into(">H", data, j + 5, height)
+            struct.pack_into(">H", data, j + 7, width)
+            patched += 1
+        j = data.find(b"\xff\xc0", j + 2)
+    assert patched > 0, "no SOF0 markers found in generated MJPG AVI"
+    open(dst, "wb").write(data)
+    return dst
+
+
+def _write_wav(path: str, seconds: float = 1.0, rate: int = 16000) -> str:
+    from scipy.io import wavfile
+
+    t = np.arange(int(seconds * rate)) / rate
+    wave = (0.3 * np.sin(2 * np.pi * 440.0 * t) * 32767).astype(np.int16)
+    wavfile.write(path, rate, wave)
+    return path
+
+
+# -- the corpus -------------------------------------------------------
+
+
+def build_corpus(root: str) -> Dict[str, HostileEntry]:
+    """Generate every corpus file under ``root`` and return the entries
+    keyed by name. Deterministic: same root -> byte-identical files."""
+    os.makedirs(root, exist_ok=True)
+    p = lambda n: os.path.join(root, n)  # noqa: E731
+    entries: Dict[str, HostileEntry] = {}
+
+    def add(e: HostileEntry) -> None:
+        entries[e.name] = e
+
+    # healthy baseline: proves the pipeline under test actually works,
+    # so a corpus-wide 'everything failed' cannot pass vacuously
+    synth_video(p("ok.mp4"), n_frames=60, width=64, height=48)
+    add(HostileEntry("ok", p("ok.mp4"), "ok", batch_terminal="done"))
+
+    # zero-byte upload (interrupted transfer)
+    open(p("zero_byte.mp4"), "wb").close()
+    add(HostileEntry("zero_byte", p("zero_byte.mp4"), "reject",
+                     batch_terminal="failed", reason_contains="empty file"))
+
+    # wrong bytes behind a media extension (text served as .mp4)
+    with open(p("text_as.mp4"), "w") as f:
+        f.write("this is not a video\n" * 64)
+    add(HostileEntry("text_as_mp4", p("text_as.mp4"), "reject",
+                     batch_terminal="failed",
+                     reason_contains="container does not open"))
+
+    # truncated mp4: moov atom lives at the tail, so a cut upload
+    # loses the index entirely and the container refuses to open
+    synth_video(p("full.mp4"), n_frames=60, width=64, height=48)
+    _truncate(p("full.mp4"), p("truncated.mp4"), 0.6)
+    add(HostileEntry("truncated_mp4", p("truncated.mp4"), "reject",
+                     batch_terminal="failed",
+                     reason_contains="container does not open"))
+
+    # bit-flipped mp4 header (bytes 4..40 inverted): the container
+    # still opens but declares an insane NEGATIVE frame count; frames
+    # themselves decode. The probe must sanitize the declared count to
+    # a warning, not reject a recoverable stream.
+    data = bytearray(open(p("full.mp4"), "rb").read())
+    for i in range(4, 40):
+        data[i] ^= 0xFF
+    open(p("bitflip.mp4"), "wb").write(data)
+    add(HostileEntry("bitflip_mp4", p("bitflip.mp4"), "caution",
+                     batch_terminal="done",
+                     expect_warnings=["frame count"]))
+
+    # audio-only container where video is needed
+    _write_wav(p("audio_only.wav"))
+    add(HostileEntry("audio_only_wav", p("audio_only.wav"), "reject",
+                     batch_terminal="failed",
+                     reason_contains="audio-only container"))
+
+    # the same RIFF/WAVE bytes hiding behind a video extension: caught
+    # by magic-byte sniff, not the name
+    with open(p("wav_as.mp4"), "wb") as f:
+        f.write(open(p("audio_only.wav"), "rb").read())
+    add(HostileEntry("wav_as_mp4", p("wav_as.mp4"), "reject",
+                     batch_terminal="failed",
+                     reason_contains="audio-only container"))
+
+    # 1-frame video: healthy media, but shorter than any model window —
+    # must fail at sampling with counts, not crash a worker
+    _write_avi_mjpg(p("one_frame.avi"), n_frames=1)
+    add(HostileEntry("one_frame", p("one_frame.avi"), "ok",
+                     batch_terminal="failed"))
+
+    # fps ~= 0 in the stream header: timestamps need a recorded default
+    _write_avi_mjpg(p("fps_base.avi"), n_frames=12)
+    _patch_fps_zero(p("fps_base.avi"), p("fps_zero.avi"))
+    add(HostileEntry("fps_zero", p("fps_zero.avi"), "caution",
+                     batch_terminal="done",
+                     expect_warnings=["fps"]))
+
+    # dimension lie inside the bitstream: container opens, zero frames
+    # decode — only the probe's first-frame grab catches it pre-queue
+    _write_avi_mjpg(p("dims_base.avi"), n_frames=8)
+    _patch_sof_dims(p("dims_base.avi"), p("huge_dims.avi"), 65500, 65500)
+    add(HostileEntry("huge_dims", p("huge_dims.avi"), "reject",
+                     batch_terminal="failed",
+                     reason_contains="no decodable frames"))
+
+    # truncated MJPG AVI: opens, declares 60 frames, decodes ~half —
+    # THE salvage vector: features for the prefix + partial_decode
+    _write_avi_mjpg(p("avi_full.avi"), n_frames=60)
+    _truncate(p("avi_full.avi"), p("truncated_half.avi"), 0.5)
+    add(HostileEntry("truncated_half_avi", p("truncated_half.avi"), "ok",
+                     batch_terminal="done",
+                     expect_warnings=["partial decode"]))
+
+    # truncated so deep only ~2 frames survive (any deeper and the AVI
+    # header itself is cut and the container rejects at open): cannot
+    # fill one model window -> permanent with decoded/declared counts
+    _truncate(p("avi_full.avi"), p("truncated_deep.avi"), 0.25)
+    add(HostileEntry("truncated_deep_avi", p("truncated_deep.avi"), "ok",
+                     batch_terminal="failed"))
+
+    # video with no audio stream, submitted to an audio consumer
+    # (vggish): cv2-written mp4 never carries audio. Probe under
+    # need='audio' is caution (openable container; stream presence
+    # resolves at rip time) — the rip itself needs ffmpeg, so the
+    # end-to-end variant is gated on its presence in tests.
+    synth_video(p("video_only.mp4"), n_frames=12, width=64, height=48)
+    add(HostileEntry("video_only_mp4", p("video_only.mp4"), "ok"))
+
+    return entries

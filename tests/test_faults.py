@@ -242,7 +242,10 @@ def test_decode_hang_hits_deadline_and_exhausts_retries(toy_videos, tmp_path, ca
 def test_corrupt_video_fails_fast_no_retry(toy_videos, tmp_path, capsys):
     bad = tmp_path / "bad.mp4"
     bad.write_bytes(b"not a video at all")
-    cfg = _cfg([toy_videos[0], str(bad)], tmp_path, retries=2)
+    # preflight off: this test pins the decode-path classification; the
+    # preflight-on rejection of the same file is covered in
+    # tests/test_hostile_media.py
+    cfg = _cfg([toy_videos[0], str(bad)], tmp_path, retries=2, preflight="off")
     ToyExtractor(cfg)()
     s = _summary(cfg)
     assert s["done"] == 1 and s["failed"] == 1 and s["retries"] == 0

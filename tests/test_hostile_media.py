@@ -1,0 +1,514 @@
+"""Hostile-media hardening (ISSUE 9): preflight probe, resource caps,
+salvage decode, audio failure taxonomy, and breaker correctness —
+exercised over the generated corrupt-media corpus (tests/hostile_media.py)
+through BOTH the batch extractor loop and the live serve daemon.
+
+The acceptance contract pinned here: every corpus file reaches a defined
+terminal state on both paths, zero worker deaths, zero breaker openings,
+zero retries burned on permanent (input-classified) failures; a
+truncated stream whose decodable prefix fills >=1 model window yields
+features plus a ``partial_decode`` warning, one that cannot fails
+permanent with decoded/declared counts.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig, parse_serve_args, sanity_check
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io import audio as audio_mod
+from video_features_tpu.io import probe
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import (
+    pop_decode_warnings,
+    read_all_frames,
+    read_all_frames_with_meta,
+    require_window,
+    set_resource_caps,
+)
+from video_features_tpu.runtime import faults
+from video_features_tpu.serve.daemon import ServeDaemon
+from video_features_tpu.serve.lifecycle import InvalidMedia
+from video_features_tpu.serve.sources import SpoolWatcher
+from video_features_tpu.serve.supervisor import CircuitBreaker
+
+from hostile_media import build_corpus
+
+pytestmark = pytest.mark.hostile
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_decode_state():
+    """Caps and the injector are process-global (installed per
+    extractor __init__); never leak one test's setup into the suite."""
+    yield
+    set_resource_caps(None)
+    faults.install_injector(None)
+    pop_decode_warnings()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    return build_corpus(str(tmp_path_factory.mktemp("hostile_corpus")))
+
+
+# --- probe unit layer --------------------------------------------------------
+
+
+def test_probe_verdicts_across_corpus(corpus):
+    for e in corpus.values():
+        rep = probe.preflight(e.path, need="video")
+        assert rep.verdict == e.probe_verdict, (e.name, rep.reason, rep.warnings)
+        if e.reason_contains:
+            assert e.reason_contains in rep.reason, (e.name, rep.reason)
+        if rep.verdict == "caution":
+            assert rep.warnings, e.name
+
+
+def test_probe_report_is_structured(corpus):
+    rep = probe.preflight(corpus["ok"].path, need="video")
+    d = rep.as_dict()
+    assert d["verdict"] == "ok" and d["width"] == 64 and d["height"] == 48
+    assert d["frame_count"] == 60 and d["first_frame_ok"] is True
+    assert rep.fps == pytest.approx(25.0)
+    assert rep.duration_s == pytest.approx(60 / 25.0)
+
+
+def test_probe_reject_maps_to_permanent_input_error(corpus):
+    rep = probe.preflight(corpus["truncated_mp4"].path, need="video")
+    exc = rep.to_error()
+    assert isinstance(exc, faults.MediaRejected)
+    assert faults.classify_error(exc) == "permanent"
+    assert faults.is_input_error(exc)
+    assert exc.stage == "preflight"
+    assert corpus["truncated_mp4"].path in str(exc)
+
+
+def test_probe_audio_need(corpus):
+    # a bare .wav is a legitimate vggish input
+    assert probe.preflight(corpus["audio_only_wav"].path, need="audio").verdict == "ok"
+    # RIFF/WAVE behind a video extension: sniffed, still fine for audio
+    assert probe.preflight(corpus["wav_as_mp4"].path, need="audio").verdict == "ok"
+    # a video container under need=audio: admitted with a caution (the
+    # audio stream's existence only resolves at rip time)
+    rep = probe.preflight(corpus["video_only_mp4"].path, need="audio")
+    assert rep.verdict == "caution"
+    assert any("audio stream" in w for w in rep.warnings)
+
+
+def test_probe_missing_and_directory(tmp_path):
+    assert probe.preflight(str(tmp_path / "nope.mp4")).verdict == "reject"
+    rep = probe.preflight(str(tmp_path))
+    assert rep.verdict == "caution"  # i3d flow-dir entries: skip, don't lie
+
+
+# --- resource caps -----------------------------------------------------------
+
+
+def test_preflight_caps_reject_on_declared_metadata(corpus):
+    ok = corpus["ok"].path  # 64x48, 60 frames @ 25 fps
+    for caps, what in [
+        (probe.ResourceCaps(max_pixels=1000), "--max_pixels"),
+        (probe.ResourceCaps(max_duration_s=1.0), "--max_duration_s"),
+        (probe.ResourceCaps(max_decode_bytes=100_000), "--max_decode_bytes"),
+    ]:
+        rep = probe.preflight(ok, need="video", caps=caps)
+        assert rep.verdict == "reject" and rep.cap_exceeded, what
+        assert what in rep.reason
+        exc = rep.to_error()
+        assert isinstance(exc, faults.ResourceCapExceeded)
+        assert faults.classify_error(exc) == "permanent"
+    # generous caps admit
+    roomy = probe.ResourceCaps(
+        max_pixels=10_000, max_duration_s=10.0, max_decode_bytes=10**8
+    )
+    assert probe.preflight(ok, need="video", caps=roomy).verdict == "ok"
+
+
+def test_running_byte_budget_catches_lying_metadata(corpus):
+    # bitflip: declared frame count is insane (unknown), so declared-
+    # metadata cap checks can't fire — the reader's running budget must
+    bad = corpus["bitflip_mp4"].path
+    set_resource_caps(probe.ResourceCaps(max_decode_bytes=5 * 64 * 48 * 3))
+    with pytest.raises(faults.ResourceCapExceeded, match="max_decode_bytes"):
+        read_all_frames(bad)
+    set_resource_caps(probe.ResourceCaps(max_duration_s=0.2))  # ~5 frames
+    with pytest.raises(faults.ResourceCapExceeded, match="max_duration_s"):
+        read_all_frames(bad)
+    set_resource_caps(None)
+    frames, _, _ = read_all_frames(bad)  # uncapped: the stream is fine
+    assert len(frames) == 60
+
+
+def test_caps_config_validation():
+    sanity_check(ExtractionConfig(max_pixels=1, max_duration_s=0.5,
+                                  max_decode_bytes=1))
+    for kw in ({"max_pixels": 0}, {"max_duration_s": 0.0},
+               {"max_decode_bytes": 0}, {"preflight": "maybe"}):
+        with pytest.raises(ValueError):
+            sanity_check(ExtractionConfig(**kw))
+
+
+# --- salvage decode ----------------------------------------------------------
+
+
+def test_truncated_prefix_decodes_with_partial_note(corpus):
+    frames, fps, stamps, declared = read_all_frames_with_meta(
+        corpus["truncated_half_avi"].path
+    )
+    assert declared == 60 and 0 < len(frames) < 60
+    assert fps == pytest.approx(25.0)
+    notes = pop_decode_warnings()
+    partial = [n for n in notes if n["kind"] == "partial_decode"]
+    assert len(partial) == 1
+    assert partial[0]["decoded"] == len(frames) and partial[0]["declared"] == 60
+
+
+def test_require_window_reports_counts(corpus):
+    frames, _, _, declared = read_all_frames_with_meta(
+        corpus["truncated_deep_avi"].path
+    )
+    assert declared == 60 and 0 < len(frames) < 4
+    with pytest.raises(faults.CorruptVideoError) as ei:
+        require_window(frames, 4, corpus["truncated_deep_avi"].path,
+                       declared=declared)
+    msg = str(ei.value)
+    assert f"{len(frames)} of 60 declared frames" in msg
+    assert "window needs 4" in msg
+    assert faults.classify_error(ei.value) == "permanent"
+
+
+def test_fps_zero_becomes_recorded_default_not_silence(corpus):
+    frames, fps, stamps = read_all_frames(corpus["fps_zero"].path)
+    assert frames and fps == pytest.approx(25.0)
+    notes = pop_decode_warnings()
+    assert any(n["kind"] == "fps_defaulted" for n in notes)
+    # healthy video: no notes at all
+    read_all_frames(corpus["ok"].path)
+    assert pop_decode_warnings() == []
+
+
+# --- audio failure taxonomy --------------------------------------------------
+
+
+def test_read_wav_wraps_parse_failures_permanent(corpus, tmp_path):
+    junk = tmp_path / "junk.wav"
+    junk.write_bytes(b"RIFFxxxxWAVEjunkjunk")
+    with pytest.raises(faults.AudioDecodeError) as ei:
+        audio_mod.read_wav(str(junk))
+    assert faults.classify_error(ei.value) == "permanent"
+    assert faults.is_input_error(ei.value)
+    data, rate = audio_mod.read_wav(corpus["audio_only_wav"].path)
+    assert rate == 16000 and len(data) > 0
+
+
+def test_rip_failures_classified_by_cause(tmp_path, monkeypatch):
+    from video_features_tpu.io import ffmpeg as ffmpeg_mod
+
+    vid = str(tmp_path / "v.mp4")
+    open(vid, "wb").write(b"x")
+
+    def rip_raising(msg):
+        def _rip(*a, **k):
+            raise RuntimeError(msg)
+        return _rip
+
+    # no audio stream: precise permanent reason, not a generic rip error
+    monkeypatch.setattr(ffmpeg_mod, "extract_wav_from_video",
+                        rip_raising("ffmpeg failed (exit 1): Stream map 'a' "
+                                    "matches no streams"))
+    with pytest.raises(faults.MissingStreamError, match="no audio stream"):
+        audio_mod.load_audio_for_model(vid, 16000, str(tmp_path), False)
+    # corrupt bitstream: permanent AudioDecodeError
+    monkeypatch.setattr(ffmpeg_mod, "extract_wav_from_video",
+                        rip_raising("ffmpeg failed (exit 1): invalid data "
+                                    "found when processing input"))
+    with pytest.raises(faults.AudioDecodeError, match="bitstream"):
+        audio_mod.load_audio_for_model(vid, 16000, str(tmp_path), False)
+    # missing ffmpeg is INFRA, not input: must pass through unclassified
+    monkeypatch.setattr(ffmpeg_mod, "extract_wav_from_video",
+                        rip_raising("ffmpeg binary not found. install it"))
+    with pytest.raises(RuntimeError) as ei:
+        audio_mod.load_audio_for_model(vid, 16000, str(tmp_path), False)
+    assert not faults.is_input_error(ei.value)
+
+
+# --- batch acceptance over the corpus ----------------------------------------
+
+
+class WindowToy(BaseExtractor):
+    """Windowed toy: decode everything, demand a 4-frame window — the
+    smallest extractor that exercises preflight, salvage, and
+    require_window through the real run loop."""
+
+    feature_type = "toy"
+    WINDOW = 4
+
+    def _build(self, device):
+        return {"device": device}
+
+    def prepare(self, path_entry):
+        path = video_path_of(path_entry)
+        frames, _, _, declared = read_all_frames_with_meta(path)
+        require_window(frames, self.WINDOW, path, declared=declared)
+        return np.asarray([float(f.mean()) for f in frames], dtype=np.float32)
+
+    def extract_prepared(self, device, state, path_entry, payload):
+        return {"toy": np.asarray(payload).reshape(-1, 1)}
+
+
+def _batch_cfg(videos, tmp_path, **kw):
+    kw.setdefault("decode_workers", 1)
+    kw.setdefault("retry_backoff", 0.01)
+    return ExtractionConfig(
+        allow_random_init=True,
+        video_paths=list(videos),
+        on_extraction="save_numpy",
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+        **kw,
+    )
+
+
+def test_batch_acceptance_every_file_terminal(corpus, tmp_path):
+    entries = [e for e in corpus.values() if e.batch_terminal]
+    cfg = _batch_cfg([e.path for e in entries], tmp_path, retries=2)
+    WindowToy(cfg)()
+    s = faults.finalize_run(cfg.output_path)
+    assert s is not None
+    # every file reached a defined terminal state; nothing died or retried
+    assert s["total"] == len(entries)
+    assert s["worker_deaths"] == []
+    assert s["retries"] == 0
+    warn_by_video = {}
+    for w in s["warnings"]:
+        warn_by_video.setdefault(w["video"], []).append(w["message"])
+    for e in entries:
+        rec = s["videos"][e.path]
+        assert rec["status"] == e.batch_terminal, (e.name, rec)
+        if rec["status"] == "failed":
+            assert rec["error_class"] == "permanent", (e.name, rec)
+            assert rec["attempts"] == 1, (e.name, rec)
+            if e.reason_contains:
+                assert e.reason_contains in rec["message"], (e.name, rec)
+        for frag in e.expect_warnings:
+            assert any(frag in m for m in warn_by_video.get(e.path, [])), (
+                e.name, frag, warn_by_video.get(e.path))
+    # the salvage contract, nailed to specific entries: enough prefix ->
+    # features + partial_decode; not enough -> permanent with counts
+    half = s["videos"][corpus["truncated_half_avi"].path]
+    assert half["status"] == "done"
+    deep = s["videos"][corpus["truncated_deep_avi"].path]
+    assert deep["status"] == "failed"
+    assert "of 60 declared frames decoded, window needs 4" in deep["message"]
+    one = s["videos"][corpus["one_frame"].path]
+    assert "1 of 1 declared frames decoded" in one["message"]
+    # preflight rejects carry their stage
+    assert s["videos"][corpus["zero_byte"].path]["stage"] == "preflight"
+    assert s["videos"][corpus["zero_byte"].path]["error_type"] == "MediaRejected"
+
+
+def test_batch_preflight_off_still_terminal(corpus, tmp_path):
+    # --preflight off: the decode path itself must absorb the same files
+    bad = [corpus["zero_byte"].path, corpus["truncated_half_avi"].path]
+    cfg = _batch_cfg(bad, tmp_path, retries=1, preflight="off")
+    WindowToy(cfg)()
+    s = faults.finalize_run(cfg.output_path)
+    assert s["videos"][bad[0]]["status"] == "failed"
+    assert s["videos"][bad[0]]["error_class"] == "permanent"
+    assert s["videos"][bad[1]]["status"] == "done"
+
+
+def test_batch_cap_as_flag_rejects_at_preflight(corpus, tmp_path):
+    cfg = _batch_cfg([corpus["ok"].path], tmp_path, max_pixels=1000)
+    WindowToy(cfg)()
+    s = faults.finalize_run(cfg.output_path)
+    rec = s["videos"][corpus["ok"].path]
+    assert rec["status"] == "failed"
+    assert rec["error_type"] == "ResourceCapExceeded"
+    assert "--max_pixels" in rec["message"]
+    assert rec["attempts"] == 1 and s["retries"] == 0
+
+
+# --- serve acceptance --------------------------------------------------------
+
+
+def _daemon(tmp_path, **flags):
+    argv = [
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "srv_out"),
+        "--tmp_path", str(tmp_path / "srv_tmp"),
+        "--allow_random_init", "--cpu",
+        "--heartbeat_s", "0",
+    ]
+    for k, v in flags.items():
+        argv += [f"--{k}"] + ([str(v)] if v is not True else [])
+    scfg = parse_serve_args(argv)
+
+    class Toy(WindowToy):
+        pass
+
+    return ServeDaemon(scfg, build=Toy)
+
+
+def _drain(d):
+    for g in d.batcher.take_ready(now=float("inf")):
+        d.batcher._run_group(g)
+
+
+def _submit(d, rid, path):
+    return d.submit({"feature_type": "resnet18", "video_path": path,
+                     "id": rid}, source="local")
+
+
+def test_serve_acceptance_every_file_terminal(corpus, tmp_path):
+    d = _daemon(tmp_path, max_group_size=4)
+    rejected, admitted = [], []
+    for e in corpus.values():
+        if e.batch_terminal is None:
+            continue
+        try:
+            _submit(d, f"h-{e.name}", e.path)
+            admitted.append(e)
+        except InvalidMedia as exc:
+            rejected.append(e)
+            # durable rejected record written BEFORE the raise, and the
+            # exception carries it for the HTTP 422 body
+            rec = d.tracker.get(f"h-{e.name}")
+            assert rec["state"] == "rejected"
+            assert exc.record["state"] == "rejected"
+            if e.reason_contains:
+                assert e.reason_contains in rec["message"], (e.name, rec)
+    # exactly the probe-reject entries bounce at admission
+    assert {e.name for e in rejected} == {
+        e.name for e in corpus.values()
+        if e.batch_terminal and e.probe_verdict == "reject"
+    }
+    _drain(d)
+    for e in admitted:
+        rec = d.tracker.get(f"h-{e.name}")
+        want = "done" if e.batch_terminal == "done" else "failed"
+        assert rec["state"] == want, (e.name, rec)
+    # the whole corpus moved nothing on the breaker and killed no worker
+    assert d.status()["status"] == "ok"
+    for b in d._breakers.values():
+        assert b.state() == "closed" and b.snapshot()["opens"] == 0
+    ext = d.pool._extractors["resnet18"]
+    assert faults.merge_manifest(d.cfg.output_path)["worker_deaths"] == []
+    assert ext is not None
+    d.shutdown()
+
+
+def test_serve_http_422_body_shape(corpus, tmp_path):
+    d = _daemon(tmp_path, port=0, max_batch_wait_ms=10)
+    d.start()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{d.http_port}/v1/extract",
+                data=json.dumps(payload).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, body = post({"feature_type": "resnet18", "id": "bad-0",
+                           "video_path": corpus["truncated_mp4"].path})
+        assert code == 422
+        assert body["reason_code"] == "invalid_media"
+        assert "container does not open" in body["error"]
+        assert body["record"]["state"] == "rejected"
+        assert d.tracker.get("bad-0")["state"] == "rejected"
+        # plain malformed requests keep their 400 (not 422)
+        assert post({"feature_type": "resnet18"})[0] == 400
+        # and a healthy file still rides straight through
+        code, rec = post({"feature_type": "resnet18", "id": "good-0",
+                          "video_path": corpus["ok"].path})
+        assert code == 202 and rec["state"] == "queued"
+    finally:
+        d.shutdown()
+
+
+def test_serve_spool_quarantines_invalid_media(corpus, tmp_path):
+    d = _daemon(tmp_path)
+    spool = str(tmp_path / "spool")
+    w = SpoolWatcher(d, spool, poll_s=0.05)
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, "bad.json"), "w") as fh:
+        json.dump({"feature_type": "resnet18", "id": "sp-0",
+                   "video_path": corpus["zero_byte"].path}, fh)
+    assert w.poll_once() == 0
+    assert os.path.exists(os.path.join(spool, "bad.json.bad"))
+    why = open(os.path.join(spool, "bad.json.bad.why")).read()
+    assert "InvalidMedia" in why and "empty file" in why
+    assert d.tracker.get("sp-0")["state"] == "rejected"
+    d.shutdown()
+
+
+# --- breaker correctness -----------------------------------------------------
+
+
+def test_breaker_ignores_input_classified_group_crash(corpus, tmp_path):
+    """N corrupt-input group crashes leave the breaker closed; the same
+    N infra crashes open it — the regression ISSUE 9 exists to pin."""
+    d = _daemon(tmp_path, fault_inject="extractor:corrupt:1",
+                breaker_threshold=1, breaker_cooldown_s=60.0)
+    for i in range(3):
+        _submit(d, f"c-{i}", corpus["ok"].path)
+        _drain(d)
+        rec = d.tracker.get(f"c-{i}")
+        assert rec["state"] == "failed" and "corrupt" in rec["message"]
+    b = d._breaker("resnet18")
+    assert b.state() == "closed" and b.snapshot()["opens"] == 0
+    assert d.status()["status"] == "ok"
+    d.shutdown()
+
+    d2 = _daemon(tmp_path, fault_inject="extractor:error:1",
+                 breaker_threshold=1, breaker_cooldown_s=60.0)
+    _submit(d2, "e-0", corpus["ok"].path)
+    _drain(d2)
+    assert d2._breaker("resnet18").state() == "open"
+    d2.shutdown()
+
+
+def test_breaker_record_ignored_state_machine():
+    clock = [0.0]
+    b = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=lambda: clock[0])
+    # closed: ignored outcomes neither advance nor reset the streak
+    assert not b.record_failure()
+    b.record_ignored()
+    assert b.state() == "closed"
+    assert b.record_failure()  # second REAL failure still opens
+    assert b.state() == "open"
+    # half-open: an input-classified probe outcome releases the slot
+    # without a verdict — the next group re-probes, state unchanged
+    clock[0] = 10.0
+    assert b.state() == "half_open"
+    assert b.try_probe()
+    assert not b.allow_request()  # probe slot held
+    b.record_ignored()
+    assert b.state() == "half_open"
+    assert b.allow_request() and b.try_probe()  # slot free again
+    b.record_success()
+    assert b.state() == "closed"
+
+
+# --- graftcheck scope --------------------------------------------------------
+
+
+@pytest.mark.analysis
+def test_probe_is_in_graftcheck_fastpath_scope():
+    from video_features_tpu.analysis.core import collect_sources
+
+    src = {s.rel: s for s in collect_sources()}["io/probe.py"]
+    assert src.is_hot and src.is_thread_root
+    assert "graftcheck:" not in src.text  # zero waivers, per ISSUE 9

@@ -378,7 +378,10 @@ def test_failed_video_yields_failed_request_record(tmp_path, serve_videos):
     bad = str(tmp_path / "corrupt.mp4")
     with open(bad, "wb") as fh:
         fh.write(b"not a video at all")
-    d, _ = _daemon(tmp_path, serve_videos, max_group_size=2)
+    # preflight off so the corrupt file reaches extraction: this test pins
+    # the in-flight failure record; admission-time rejection is covered in
+    # tests/test_hostile_media.py
+    d, _ = _daemon(tmp_path, serve_videos, max_group_size=2, preflight="off")
     d.submit({"feature_type": "resnet18", "video_path": bad, "id": "bad-0"},
              source="local")
     d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],

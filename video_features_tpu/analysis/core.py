@@ -98,6 +98,10 @@ HOT_MODULE_PATTERNS = (
     # the daemon's per-request path: admission, dispatch glue, lifecycle
     # writes — all on the serving fast path (ISSUE 7)
     "serve/*.py",
+    # preflight probe runs once per admitted request/ingested video —
+    # on the fast path by construction, budgeted <1% of per-video time
+    # (ISSUE 9); zero waivers allowed here
+    "io/probe.py",
 )
 
 # Thread-spawning roots for the thread-safety reachability walk: the
@@ -113,6 +117,9 @@ THREAD_ROOT_PATTERNS = (
     # the serve daemon: batcher dispatcher thread, HTTP handler threads,
     # spool watcher thread all mutate shared admission/lifecycle state
     "serve/*.py",
+    # the probe runs on HTTP handler threads (serve admission) and the
+    # batch main thread concurrently; it must hold no mutable globals
+    "io/probe.py",
 )
 
 

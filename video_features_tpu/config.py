@@ -243,6 +243,19 @@ class ExtractionConfig:
     # re-encode) exceeding it raises DecodeTimeout — classified
     # transient, so the video retries with a fresh deadline. None = off.
     decode_timeout: Optional[float] = None
+    # Preflight probe (io/probe.py) before each video's first attempt:
+    # 'on' rejects hostile/corrupt inputs as permanent manifest failures
+    # (zero retries burned) and records metadata warnings; 'off' lets
+    # the decode path discover problems itself (the pre-ISSUE-9
+    # behaviour).
+    preflight: str = "on"
+    # Input resource caps, enforced twice (docs/robustness.md "hostile
+    # input"): at preflight from declared metadata, and as a running
+    # budget over actual decode so a lying header cannot blow host RAM.
+    # Over-budget raises ResourceCapExceeded (permanent). None = off.
+    max_pixels: Optional[int] = None        # per-frame width*height
+    max_duration_s: Optional[float] = None  # declared/decoded clip length
+    max_decode_bytes: Optional[int] = None  # total RGB bytes one reader may yield
     # Deterministic fault injection, test-only: STAGE:KIND:EVERY_N specs
     # (stage in decode/prepare/dispatch/sink; kind in error/corrupt/
     # hang/oom/compile/kill) raise or stall at that stage every N calls,
@@ -396,6 +409,16 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise ValueError(f"retry_backoff must be >= 0, got {cfg.retry_backoff}")
     if cfg.decode_timeout is not None and cfg.decode_timeout <= 0:
         raise ValueError(f"decode_timeout must be > 0, got {cfg.decode_timeout}")
+    if cfg.preflight not in ("on", "off"):
+        raise ValueError(f"preflight must be 'on' or 'off', got {cfg.preflight!r}")
+    if cfg.max_pixels is not None and cfg.max_pixels < 1:
+        raise ValueError(f"max_pixels must be >= 1, got {cfg.max_pixels}")
+    if cfg.max_duration_s is not None and cfg.max_duration_s <= 0:
+        raise ValueError(f"max_duration_s must be > 0, got {cfg.max_duration_s}")
+    if cfg.max_decode_bytes is not None and cfg.max_decode_bytes < 1:
+        raise ValueError(
+            f"max_decode_bytes must be >= 1, got {cfg.max_decode_bytes}"
+        )
     if cfg.retry_failed and not cfg.resume:
         raise ValueError(
             "--retry_failed only modifies --resume (it re-attempts videos "
@@ -560,6 +583,24 @@ def build_arg_parser(feature_required: bool = True) -> argparse.ArgumentParser:
                    help="wall-clock seconds per decode before a "
                         "DecodeTimeout (transient -> retried with a "
                         "fresh deadline)")
+    p.add_argument("--preflight", choices=["on", "off"], default="on",
+                   help="probe each input before its first attempt "
+                        "(io/probe.py): hostile/corrupt media fails "
+                        "permanent with the probe's reason and zero "
+                        "retries; 'off' restores discover-at-decode")
+    p.add_argument("--max_pixels", type=int, default=None,
+                   help="reject/abort any input whose frames exceed this "
+                        "many pixels (width*height) — checked against "
+                        "declared metadata at preflight AND against "
+                        "actual decoded frames")
+    p.add_argument("--max_duration_s", type=float, default=None,
+                   help="reject/abort any input longer than this many "
+                        "seconds (declared at preflight; enforced again "
+                        "over actual decode)")
+    p.add_argument("--max_decode_bytes", type=int, default=None,
+                   help="abort any single video whose decoded RGB bytes "
+                        "exceed this budget (a lying frame_count/"
+                        "resolution header cannot blow host RAM)")
     p.add_argument("--fault_inject", action="append", default=None,
                    metavar="STAGE:KIND:EVERY_N",
                    help="TEST-ONLY deterministic fault injection: raise/"

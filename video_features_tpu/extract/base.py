@@ -50,6 +50,10 @@ class BaseExtractor:
     # transformer token axis to shard, and its _build injects ring
     # attention (parallel/ring_attention.py) when the flag is set.
     mesh_context_capable: bool = False
+    # what the preflight probe (io/probe.py) must find in an input:
+    # 'video' for frame consumers, 'audio' for the VGGish path (a wav
+    # is then legitimate and a RIFF/WAVE container is not a reject)
+    media_need: str = "video"
 
     def __init__(self, config, external_call: bool = False) -> None:
         self.config = as_config(config)
@@ -111,9 +115,15 @@ class BaseExtractor:
             # committed per-bucket budget (analysis/compile_budget.json)
             self.telemetry.arm_recompile_watch(self.manifest)
         faults.install_injector(getattr(self.config, "fault_inject", None))
-        from video_features_tpu.io.video import set_decode_timeout
+        from video_features_tpu.io.probe import ResourceCaps
+        from video_features_tpu.io.video import set_decode_timeout, set_resource_caps
 
         set_decode_timeout(getattr(self.config, "decode_timeout", None))
+        # --max_pixels/--max_duration_s/--max_decode_bytes: the running
+        # decode budget every reader snapshots (io/video.py), plus the
+        # declared-metadata caps the preflight probe checks
+        self._resource_caps = ResourceCaps.from_config(self.config)
+        set_resource_caps(self._resource_caps)
         self._t0: Dict[str, float] = {}  # video key -> attempt start
         # --preprocess device degradation: a thread-local force-host flag
         # lets ONE video's fallback re-prepare through the host chain
@@ -350,6 +360,50 @@ class BaseExtractor:
         self.manifest.record(self._video_key(entry), "skipped", message=reason)
         self.progress.update()
 
+    def _preflight_entry(self, entry) -> None:
+        """The vouching stage before a video's FIRST attempt
+        (``--preflight on``): probe the container, record caution
+        warnings, and raise the probe's permanent taxonomy error on
+        reject — so hostile media fails with a precise reason before a
+        single retry (or any real decode work) is spent on it. Raises
+        from inside prepare/extract try-blocks; ``_on_failure``
+        classifies the error permanent and the stage 'preflight'."""
+        if getattr(self.config, "preflight", "off") != "on":
+            return
+        from video_features_tpu.io import probe as probe_mod
+
+        report = probe_mod.preflight(
+            self._video_key(entry),
+            need=self.media_need,
+            caps=self._resource_caps,
+        )
+        for w in report.warnings:
+            self.manifest.record(
+                self._video_key(entry), "warning", stage="preflight", message=w
+            )
+        if report.verdict == "reject":
+            raise report.to_error()
+
+    def _drain_decode_warnings(self, entry) -> None:
+        """Move this thread's accumulated decode notes (fps defaulted,
+        partial decode — io/video.py) into the manifest as per-video
+        warnings. Must run on the thread that decoded (the notes are
+        thread-local), i.e. inside prep() / the serial loop."""
+        from video_features_tpu.io.video import pop_decode_warnings
+
+        for note in pop_decode_warnings():
+            extra = {
+                k: v for k, v in note.items() if k not in ("kind", "message")
+            }
+            self.manifest.record(
+                self._video_key(entry),
+                "warning",
+                stage="decode",
+                kind=note.get("kind"),
+                message=note.get("message"),
+                **extra,
+            )
+
     def _mark_start(self, entry) -> None:
         self._t0[self._video_key(entry)] = time.monotonic()
 
@@ -480,7 +534,10 @@ class BaseExtractor:
         self._force_host.on = True
         try:
             with self.telemetry.span("prepare", video=video, attempt=attempt):
-                payload = self.prepare(entry)
+                try:
+                    payload = self.prepare(entry)
+                finally:
+                    self._drain_decode_warnings(entry)
             with self.telemetry.span("dispatch", video=video, attempt=attempt):
                 self.telemetry.count_h2d(payload)
                 feats_dict = self.extract_prepared(device, state, entry, payload)
@@ -573,11 +630,17 @@ class BaseExtractor:
                 time.sleep(wait)
             self._mark_start(entry)
             try:
-                with self.telemetry.span(
-                    "extract", video=self._video_key(entry),
-                    attempt=attempt, worker=wid,
-                ):
-                    feats_dict = self.extract(device, state, entry)
+                try:
+                    if attempt == 1:
+                        self._preflight_entry(entry)
+                    with self.telemetry.span(
+                        "extract", video=self._video_key(entry),
+                        attempt=attempt, worker=wid,
+                    ):
+                        feats_dict = self.extract(device, state, entry)
+                finally:
+                    # serial mode decodes on this thread: the notes are here
+                    self._drain_decode_warnings(entry)
                 self._sink_or_collect(feats_dict, entry, results, pos)
             except KeyboardInterrupt:
                 raise
@@ -632,7 +695,16 @@ class BaseExtractor:
                 attempt=attempt, worker=wid,
             ):
                 faults.fire("prepare")
-                return self.prepare(entry)
+                if attempt == 1:
+                    # preflight on the decode worker, ahead of real
+                    # decode: a reject surfaces from the future as a
+                    # permanent 'preflight'-stage failure, zero retries
+                    self._preflight_entry(entry)
+                try:
+                    return self.prepare(entry)
+                finally:
+                    # decode notes are thread-local to THIS worker
+                    self._drain_decode_warnings(entry)
 
         pending: deque = deque()  # (pos, idx, attempt, fut)
         # device pipeline (extractors with the dispatch/fetch split): one

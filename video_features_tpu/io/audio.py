@@ -28,6 +28,8 @@ from typing import Dict, Tuple
 import numpy as np
 from scipy.io import wavfile
 
+from video_features_tpu.runtime.faults import AudioDecodeError, MissingStreamError
+
 # resampy.filters.sinc_window('kaiser_best') parameters: 64 zero
 # crossings sampled at 2**9 points each, Kaiser beta tuned for ~-96 dB
 # stopband, cutoff rolled off to 0.9476 of Nyquist
@@ -36,10 +38,30 @@ _PRECISION = 9
 _ROLLOFF = 0.9475937167399596
 _BETA = 14.769656459379492
 
+# ffmpeg stderr fragments that mean "this container has no audio track"
+# — the one rip failure that deserves its own precise reason instead of
+# the generic corrupt-audio classification
+_NO_AUDIO_MARKERS = (
+    "does not contain any stream",
+    "Stream map 'a' matches no streams",
+    "matches no streams",
+)
+
 
 def read_wav(path: str) -> Tuple[np.ndarray, int]:
-    """-> (float32 samples in [-1, 1], shape (n,) or (n, ch); sample rate)."""
-    sr, data = wavfile.read(path)
+    """-> (float32 samples in [-1, 1], shape (n,) or (n, ch); sample rate).
+
+    Parse failures raise :class:`AudioDecodeError` (permanent,
+    input-classified) rather than letting scipy's bare ValueError escape
+    into the retry machinery as a maybe-transient unknown."""
+    try:
+        sr, data = wavfile.read(path)
+    except (ValueError, EOFError) as exc:
+        # scipy raises bare ValueError for bad bytes; OSErrors (missing
+        # file, I/O flake) pass through and stay transient-classifiable
+        raise AudioDecodeError(
+            f"unparseable wav ({type(exc).__name__}: {exc}): {path}"
+        ) from exc
     if data.dtype == np.int16:
         data = data / 32768.0
     elif data.dtype == np.int32:
@@ -191,7 +213,21 @@ def load_audio_for_model(
     if not path.lower().endswith(".wav"):
         from video_features_tpu.io.ffmpeg import extract_wav_from_video
 
-        path, aac = extract_wav_from_video(path, tmp_path)
+        src = path
+        try:
+            path, aac = extract_wav_from_video(path, tmp_path)
+        except RuntimeError as exc:
+            msg = str(exc)
+            if "ffmpeg binary" in msg or "binary not found" in msg:
+                raise  # missing tool is an environment problem, not bad media
+            # the rip subprocess died on the bitstream: classify it
+            if any(m in msg for m in _NO_AUDIO_MARKERS):
+                raise MissingStreamError(
+                    f"no audio stream in container: {src}"
+                ) from exc
+            raise AudioDecodeError(
+                f"audio rip failed on the bitstream: {src}: {msg[:300]}"
+            ) from exc
         tmp_files = [path, aac]
     try:
         data, sr = read_wav(path)

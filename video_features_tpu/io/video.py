@@ -37,22 +37,56 @@ utils/utils.py:312) which is a unit bug; the correct milliseconds-per-frame
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 import time
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import cv2
 import numpy as np
 
+from video_features_tpu.io.probe import MIN_SANE_FPS, NO_CAPS, ResourceCaps
 from video_features_tpu.runtime import faults
 from video_features_tpu.runtime import telemetry
-from video_features_tpu.runtime.faults import CorruptVideoError, DecodeTimeout
+from video_features_tpu.runtime.faults import (
+    CorruptVideoError,
+    DecodeTimeout,
+    ResourceCapExceeded,
+)
 
 _DECODER = "auto"  # 'auto' | 'cv2' | 'native'; set once from the config
 _DECODE_TIMEOUT: Optional[float] = None  # seconds per reader; set from the config
+_RESOURCE_CAPS: ResourceCaps = NO_CAPS  # --max_pixels etc.; set from the config
 # BaseExtractor.__init__ sets the timeout, and the serve daemon builds
 # extractors from its dispatcher thread — rebinds must hold this lock
 _CONFIG_LOCK = threading.Lock()
+
+# decode warnings (fps defaulted, partial decode) accumulate per THREAD:
+# readers are constructed deep inside samplers with no manifest in
+# reach, and prepare() runs one video per decode-worker thread at a
+# time, so thread-local accumulation maps notes to the right video when
+# extract/base.py drains them into the manifest after each attempt
+_NOTES = threading.local()
+
+
+def _note(kind: str, message: str, **fields: object) -> None:
+    items = getattr(_NOTES, "items", None)
+    if items is None:
+        items = _NOTES.items = []
+    note: Dict[str, object] = {"kind": kind, "message": message, **fields}
+    if note not in items:  # one fps-default note per video, not per reader
+        items.append(note)
+
+
+def pop_decode_warnings() -> List[Dict[str, object]]:
+    """Drain this thread's accumulated decode warnings — each is
+    ``{'kind', 'message', ...}`` (``partial_decode`` notes also carry
+    ``decoded``/``declared`` counts). extract/base.py calls this after
+    every decode attempt and records the notes as per-video manifest
+    warnings instead of letting them vanish as silent defaults."""
+    items = getattr(_NOTES, "items", None) or []
+    _NOTES.items = []
+    return items
 
 
 def set_decoder(name: str) -> None:
@@ -74,6 +108,18 @@ def set_decode_timeout(seconds: Optional[float]) -> None:
     global _DECODE_TIMEOUT
     with _CONFIG_LOCK:
         _DECODE_TIMEOUT = float(seconds) if seconds else None
+
+
+def set_resource_caps(caps: Optional[ResourceCaps]) -> None:
+    """Install the ``--max_pixels``/``--max_duration_s``/
+    ``--max_decode_bytes`` running decode budget (BaseExtractor wires it
+    from the config, like the timeout). Every subsequently-opened reader
+    snapshots the caps and raises :class:`ResourceCapExceeded` the
+    moment ACTUAL decode crosses one — the backstop for container
+    metadata that lied its way past the preflight probe."""
+    global _RESOURCE_CAPS
+    with _CONFIG_LOCK:
+        _RESOURCE_CAPS = caps or NO_CAPS
 
 
 def _resolve(decoder: Optional[str]) -> str:
@@ -122,20 +168,50 @@ class _Reader:
                     f"unavailable: {native.decoder_build_error()}"
                 )
         if self._nat is not None:
-            self.fps = self._nat.fps or 0.0
+            raw_fps = self._nat.fps or 0.0
             self.frame_count = int(self._nat.frame_count or 0)
             self.width, self.height = self._nat.width, self._nat.height
         else:
             self._cap = cv2.VideoCapture(str(path))
             if not self._cap.isOpened():
                 raise CorruptVideoError(f"cannot open video: {path}")
-            self.fps = self._cap.get(cv2.CAP_PROP_FPS) or 0.0
+            raw_fps = self._cap.get(cv2.CAP_PROP_FPS) or 0.0
             self.frame_count = int(self._cap.get(cv2.CAP_PROP_FRAME_COUNT))
             self.width = int(self._cap.get(cv2.CAP_PROP_FRAME_WIDTH))
             self.height = int(self._cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+        # near-zero/non-finite declared fps IS absent fps (a hostile AVI
+        # can declare dwScale ~2^32 -> fps ~1e-10); normalizing to 0.0
+        # routes it into the recorded 25.0-default warning path
+        self.fps = (
+            float(raw_fps)
+            if math.isfinite(raw_fps) and raw_fps >= MIN_SANE_FPS
+            else 0.0
+        )
+        if self.frame_count < 0 or self.frame_count > 10 ** 9:
+            self.frame_count = 0  # bit-flipped headers declare garbage counts
         self._path = str(path)
         self._deadline = (
             time.monotonic() + _DECODE_TIMEOUT if _DECODE_TIMEOUT else None
+        )
+        # the running resource budget (snapshot: a daemon rebind mid-read
+        # must not change this reader's contract)
+        with _CONFIG_LOCK:
+            self._caps = _RESOURCE_CAPS
+        self._grabs = 0
+        self._retrieved_bytes = 0
+        self._eof = False
+        self._closed = False
+        if self._caps.max_pixels is not None \
+                and self.width * self.height > self._caps.max_pixels:
+            self.close()
+            raise ResourceCapExceeded(
+                f"declared frame size {self.width}x{self.height} exceeds "
+                f"--max_pixels {self._caps.max_pixels}: {path}"
+            )
+        self._max_frames = (
+            int(self._caps.max_duration_s * (self.fps or 25.0)) + 1
+            if self._caps.max_duration_s is not None
+            else None
         )
         # injected 'decode' faults land here, after open: a hang eats
         # into this reader's deadline exactly like a stalled demuxer
@@ -146,9 +222,18 @@ class _Reader:
             raise DecodeTimeout(
                 f"decode exceeded --decode_timeout {_DECODE_TIMEOUT:g}s: {self._path}"
             )
-        if self._nat is not None:
-            return self._nat.grab() >= 0
-        return self._cap.grab()
+        ok = self._nat.grab() >= 0 if self._nat is not None else self._cap.grab()
+        if not ok:
+            self._eof = True
+            return False
+        self._grabs += 1
+        if self._max_frames is not None and self._grabs > self._max_frames:
+            raise ResourceCapExceeded(
+                f"decoded past --max_duration_s {self._caps.max_duration_s:g} "
+                f"(~{self._max_frames} frames at {self.fps or 25.0:g} fps) — "
+                f"declared metadata lied: {self._path}"
+            )
+        return True
 
     def retrieve(self) -> Optional[np.ndarray]:
         if self._nat is not None:
@@ -157,6 +242,23 @@ class _Reader:
             ok, frame = self._cap.retrieve()
             frame = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB) if ok else None
         if frame is not None:
+            caps = self._caps
+            if caps.max_pixels is not None:
+                px = int(frame.shape[0]) * int(frame.shape[1])
+                if px > caps.max_pixels:
+                    raise ResourceCapExceeded(
+                        f"decoded frame {frame.shape[1]}x{frame.shape[0]} "
+                        f"({px} pixels) exceeds --max_pixels "
+                        f"{caps.max_pixels}: {self._path}"
+                    )
+            if caps.max_decode_bytes is not None:
+                self._retrieved_bytes += int(frame.nbytes)
+                if self._retrieved_bytes > caps.max_decode_bytes:
+                    raise ResourceCapExceeded(
+                        f"decoded {self._retrieved_bytes} bytes, over "
+                        f"--max_decode_bytes {caps.max_decode_bytes}: "
+                        f"{self._path}"
+                    )
             telemetry.frame_decoded()
         return frame
 
@@ -164,10 +266,32 @@ class _Reader:
         return self.retrieve() if self.grab() else None
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         if self._nat is not None:
             self._nat.close()
         elif self._cap is not None:
             self._cap.release()
+        # salvage-decode bookkeeping: the stream ENDED (not a sampler
+        # stopping early) short of its declared frame count — a
+        # truncated/corrupt tail. The prefix already flowed to the
+        # caller; the note becomes a partial_decode manifest warning.
+        # Declared counts are allowed a little slack (containers
+        # estimate), so only a >5% shortfall counts as truncation.
+        if (
+            self._eof
+            and self.frame_count > 0
+            and self._grabs < self.frame_count
+            and (self.frame_count - self._grabs) > max(1, self.frame_count // 20)
+        ):
+            _note(
+                "partial_decode",
+                f"partial decode: {self._grabs} of {self.frame_count} "
+                f"declared frames decodable: {self._path}",
+                decoded=self._grabs,
+                declared=self.frame_count,
+            )
         telemetry.end(self._span)
 
     def __enter__(self):
@@ -260,6 +384,60 @@ def read_frames_at_indices(
     return got
 
 
+def _fps_or_default(r: "_Reader") -> float:
+    """The 25.0 fallback for absent fps metadata — recorded, not silent:
+    the note surfaces as a per-video manifest warning (extract/base.py
+    drains :func:`pop_decode_warnings`) so downstream timestamp
+    consumers know the clock is a guess."""
+    if r.fps:
+        return r.fps
+    _note(
+        "fps_defaulted",
+        f"fps metadata absent or ~zero; timestamps assume 25.0 fps: {r._path}",
+    )
+    return 25.0
+
+
+def _stream_from_reader(
+    r: "_Reader", extraction_fps: Optional[float]
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """The sequential frame-selection loop over an already-open reader
+    (shared by :func:`stream_frames` and :func:`read_all_frames`, which
+    used to pay a second container open just to learn the fps)."""
+    src_fps = _fps_or_default(r)
+    if extraction_fps is None:
+        i = 0
+        while True:
+            frame = r.read()
+            if frame is None:
+                break
+            yield frame, i * 1000.0 / src_fps
+            i += 1
+    else:
+        # Select source frames nearest the target fps grid while
+        # decoding sequentially. Works without a (reliable) frame
+        # count: output frame k maps to source index
+        # round(k * src_fps / dst_fps); duplicates when upsampling,
+        # drops when downsampling.
+        out_k = 0
+        src_i = -1
+        frame = None
+        while True:
+            target = int(round(out_k * src_fps / extraction_fps))
+            fresh = False
+            while src_i < target:
+                if not r.grab():
+                    return
+                fresh = True
+                src_i += 1
+            if fresh:
+                frame = r.retrieve()
+                if frame is None:
+                    return
+            yield frame, out_k * 1000.0 / extraction_fps
+            out_k += 1
+
+
 def stream_frames(
     path: str,
     extraction_fps: Optional[float] = None,
@@ -272,38 +450,7 @@ def stream_frames(
     keyframe-inaccurate); skipped grid frames are grabbed, never converted.
     """
     with _Reader(path, decoder) as r:
-        src_fps = r.fps or 25.0
-        if extraction_fps is None:
-            i = 0
-            while True:
-                frame = r.read()
-                if frame is None:
-                    break
-                yield frame, i * 1000.0 / src_fps
-                i += 1
-        else:
-            # Select source frames nearest the target fps grid while
-            # decoding sequentially. Works without a (reliable) frame
-            # count: output frame k maps to source index
-            # round(k * src_fps / dst_fps); duplicates when upsampling,
-            # drops when downsampling.
-            out_k = 0
-            src_i = -1
-            frame = None
-            while True:
-                target = int(round(out_k * src_fps / extraction_fps))
-                fresh = False
-                while src_i < target:
-                    if not r.grab():
-                        return
-                    fresh = True
-                    src_i += 1
-                if fresh:
-                    frame = r.retrieve()
-                    if frame is None:
-                        return
-                yield frame, out_k * 1000.0 / extraction_fps
-                out_k += 1
+        yield from _stream_from_reader(r, extraction_fps)
 
 
 def read_all_frames(
@@ -311,14 +458,34 @@ def read_all_frames(
     extraction_fps: Optional[float] = None,
     decoder: Optional[str] = None,
 ) -> Tuple[List[np.ndarray], float, List[float]]:
-    """Whole-clip decode -> (rgb frames, effective fps, timestamps_ms)."""
-    meta = probe(path, decoder)
-    fps = extraction_fps or meta.fps or 25.0
-    frames, stamps = [], []
-    for frame, ts in stream_frames(path, extraction_fps, decoder):
-        frames.append(frame)
-        stamps.append(ts)
+    """Whole-clip decode -> (rgb frames, effective fps, timestamps_ms).
+
+    One reader serves both the fps lookup and the stream (this used to
+    open the container twice: once via :func:`probe`, once via
+    :func:`stream_frames`)."""
+    frames, fps, stamps, _ = read_all_frames_with_meta(
+        path, extraction_fps, decoder
+    )
     return frames, fps, stamps
+
+
+def read_all_frames_with_meta(
+    path: str,
+    extraction_fps: Optional[float] = None,
+    decoder: Optional[str] = None,
+) -> Tuple[List[np.ndarray], float, List[float], int]:
+    """:func:`read_all_frames` plus the container's DECLARED frame count
+    (0 when unknown/insane) — the number :func:`require_window` failures
+    report against, so a truncated stream fails with 'N of M declared
+    frames decoded' instead of a bare N."""
+    frames, stamps = [], []
+    with _Reader(path, decoder) as r:
+        declared = r.frame_count
+        fps = extraction_fps or r.fps or 25.0
+        for frame, ts in _stream_from_reader(r, extraction_fps):
+            frames.append(frame)
+            stamps.append(ts)
+    return frames, fps, stamps, declared
 
 
 def extract_frames(
@@ -334,10 +501,19 @@ def extract_frames(
     """
     ext, *params = method.split("_")
     meta = probe(path, decoder)
-    fps, frame_cnt = meta.fps or 25.0, meta.frame_count
+    frame_cnt = meta.frame_count
+    if meta.fps:
+        fps = meta.fps
+    else:
+        _note(
+            "fps_defaulted",
+            f"fps metadata absent or ~zero; timestamps assume 25.0 fps: {path}",
+        )
+        fps = 25.0
     if frame_cnt < 3:
         raise CorruptVideoError(
-            f"video too short for sampling ({frame_cnt} frames): {path}"
+            f"video too short for sampling: {frame_cnt} of {frame_cnt} "
+            f"declared frames, sampler needs 3: {path}"
         )
     mspf = 1000.0 / fps
 
@@ -357,7 +533,11 @@ def extract_frames(
     # sampled-feature contract on it.
     got = read_frames_at_indices(path, samples_ix, decoder, allow_seek=False)
     if not got:
-        raise CorruptVideoError(f"no frames decoded from {path}")
+        # the decodable prefix cannot fill even one sample window:
+        # permanent, with decoded/declared counts for the manifest
+        raise CorruptVideoError(
+            f"no frames decoded (0 of {frame_cnt} declared frames): {path}"
+        )
     # duplicate indices in linspace (short videos) resolve to the same frame
     last_seen = None
     frames = []
@@ -367,3 +547,16 @@ def extract_frames(
         frames.append(last_seen if last_seen is not None else next(iter(got.values())))
     timestamps_ms = [float(ix) * mspf for ix in samples_ix]
     return frames, fps, timestamps_ms
+
+
+def require_window(frames, needed: int, path: str, declared: int = 0) -> None:
+    """The salvage-decode boundary for windowed extractors: a decodable
+    prefix that fills ≥1 model window proceeds (with the reader's
+    ``partial_decode`` warning already noted); one that cannot is a
+    permanent input failure recorded with decoded/declared counts."""
+    if len(frames) < max(int(needed), 1):
+        raise CorruptVideoError(
+            f"decodable prefix too short for one model window: "
+            f"{len(frames)} of {declared or 'unknown'} declared frames "
+            f"decoded, window needs {needed}: {path}"
+        )

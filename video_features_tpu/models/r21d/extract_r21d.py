@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.paths import form_slices, video_path_of
-from video_features_tpu.io.video import read_all_frames
+from video_features_tpu.io.video import read_all_frames_with_meta, require_window
 from video_features_tpu.models.common.weights import load_params, random_init_fallback
 from video_features_tpu.models.r21d.convert import convert_state_dict
 from video_features_tpu.models.r21d.model import R21D_FEATURE_DIM, build, init_params
@@ -127,11 +127,13 @@ class ExtractR21D(BaseExtractor):
     # it would after float conversion)
     def prepare(self, path_entry):
         video_path = video_path_of(path_entry)
-        frames, _, _ = read_all_frames(
+        frames, _, _, declared = read_all_frames_with_meta(
             video_path, self.config.extraction_fps, self.config.decoder
         )
-        if not frames:
-            raise IOError(f"no frames decoded from {video_path}")
+        # salvage contract: a truncated prefix proceeds (with its
+        # partial_decode warning) as long as anything decoded; zero
+        # frames is a permanent input failure with counts in the message
+        require_window(frames, 1, video_path, declared=declared)
         clip = np.stack(frames)  # (T, H, W, 3) uint8, stays on host
         slices = form_slices(clip.shape[0], self.stack_size, self.step_size)
         batches = []

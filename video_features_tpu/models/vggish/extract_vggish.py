@@ -37,6 +37,10 @@ class ExtractVGGish(BaseExtractor):
     # --sharding mesh: the 0.96 s example batch shards over 'data'
     # (pure DP; the VGG weights replicate — tiny next to activations)
     mesh_capable = True
+    # preflight contract: this path consumes audio — a bare .wav is a
+    # legitimate input here, and a video container is probed for
+    # openability only (audio-stream presence resolves at rip time)
+    media_need = "audio"
 
     def __init__(self, config, external_call: bool = False) -> None:
         super().__init__(config, external_call)

@@ -83,6 +83,39 @@ class CorruptVideoError(IOError):
     stage = "decode"
 
 
+class MediaRejected(CorruptVideoError):
+    """The preflight probe (io/probe.py) rejected the input before any
+    real decode work: container does not open, no stream of the kind the
+    consumer needs, no decodable first frame. Permanent, with the
+    probe's precise reason in the message."""
+
+    stage = "preflight"
+
+
+class ResourceCapExceeded(Exception):
+    """The input busts a declared resource cap (``--max_pixels`` /
+    ``--max_duration_s`` / ``--max_decode_bytes``) — caught either at
+    preflight from its own metadata, or by the running decode budget in
+    io/video.py when the metadata lied. Permanent: a bigger input never
+    shrinks on retry."""
+
+    stage = "decode"
+
+
+class AudioDecodeError(IOError):
+    """The audio payload is bad (unparseable wav, an ffmpeg rip that
+    dies on the bitstream) — io/audio.py's analog of
+    :class:`CorruptVideoError`. Permanent."""
+
+    stage = "decode"
+
+
+class MissingStreamError(AudioDecodeError):
+    """The container opened but carries no stream of the kind the
+    consumer needs (e.g. a silent mp4 through VGGish). Permanent, with
+    the missing stream named in the message."""
+
+
 class InjectedTransientError(OSError):
     """--fault_inject KIND=error: an I/O flake."""
 
@@ -130,7 +163,7 @@ def classify_error(exc: BaseException) -> str:
     Order matters: the specific contracts (corrupt container, decode
     deadline) win over the broad isinstance checks (CorruptVideoError IS
     an OSError, but bad bytes never become good bytes)."""
-    if isinstance(exc, CorruptVideoError):
+    if isinstance(exc, (CorruptVideoError, AudioDecodeError, ResourceCapExceeded)):
         return "permanent"
     if isinstance(exc, DecodeTimeout):
         return "transient"
@@ -152,6 +185,27 @@ def is_retryable(error_class: str) -> bool:
     'compile' is NOT retryable — the same program lowers the same way —
     it degrades to the host chain instead)."""
     return error_class in RETRYABLE_CLASSES
+
+
+# exception types that indict the INPUT rather than the stack. The serve
+# circuit breaker must ignore these — a burst of corrupt user uploads is
+# not a sick model, and tearing down a healthy resident extractor over
+# them is the hostile-traffic DoS docs/robustness.md warns about.
+# InjectedPermanentError is the test-only stand-in for "unfixable bad
+# input" and rides the same contract.
+INPUT_ERROR_TYPES = (
+    CorruptVideoError,    # includes MediaRejected
+    AudioDecodeError,     # includes MissingStreamError
+    ResourceCapExceeded,
+    InjectedPermanentError,
+)
+
+
+def is_input_error(exc: BaseException) -> bool:
+    """True when ``exc`` blames the input media, not the infrastructure
+    — the breaker-correctness predicate (serve/daemon.py gates
+    ``CircuitBreaker.record_failure`` on it)."""
+    return isinstance(exc, INPUT_ERROR_TYPES)
 
 
 def backoff_delay(attempt: int, base: float, key: str) -> float:

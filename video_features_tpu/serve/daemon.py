@@ -47,6 +47,7 @@ from video_features_tpu.serve.lifecycle import (
     TERMINAL_STATES,
     BadRequest,
     ExtractionRequest,
+    InvalidMedia,
     RequestTracker,
     parse_request,
 )
@@ -218,6 +219,12 @@ class ServeDaemon:
             print(f"serve: recovered prior run: {self.recovered['requeued']} "
                   f"requeued, {self.recovered['interrupted']} interrupted")
         self.tracker.sweep(scfg.request_ttl_s, scfg.max_request_records)
+        # admission preflight (--preflight on): one caps snapshot shared
+        # by every submit; the extractors re-derive the same caps from
+        # the same config at build time (extract/base.py)
+        from video_features_tpu.io.probe import ResourceCaps
+
+        self._caps = ResourceCaps.from_config(self.cfg)
         self.pool = ExtractorPool(self.cfg, scfg.max_group_size, build=build)
         self.batcher = AdmissionController(
             dispatch=self._dispatch_group,
@@ -273,6 +280,7 @@ class ServeDaemon:
             )
         if not os.path.exists(req.video_path):
             raise BadRequest(f"video_path does not exist: {req.video_path}")
+        self._preflight(req)
         faults.fire("admission")
         breaker = self._breaker(req.feature_type)
         if not breaker.allow_request():
@@ -294,6 +302,27 @@ class ServeDaemon:
                 self.tracker.reject(req, f"queue full ({self.scfg.max_queue})")
             raise
         return rec
+
+    def _preflight(self, req: ExtractionRequest) -> None:
+        """Admission-time media vouching (``--preflight on``). Runs
+        BEFORE the breaker gate on purpose: a corrupt upload must come
+        back 422 ``invalid_media`` even while the model's breaker is
+        open — it would never have reached the chip anyway. A reject
+        writes the durable ``rejected`` record first (the request had an
+        identity; its terminal state must survive the process), then
+        raises :class:`InvalidMedia` (HTTP -> 422 body with the record,
+        spool -> ``.bad`` + ``.why`` quarantine)."""
+        if getattr(self.cfg, "preflight", "off") != "on":
+            return
+        from video_features_tpu.io import probe as probe_mod
+
+        need = "audio" if req.feature_type in ("vggish", "vggish_torch") else "video"
+        report = probe_mod.preflight(req.video_path, need=need, caps=self._caps)
+        if report.verdict != "reject":
+            return
+        reason = f"invalid media: {report.reason}"
+        rec = self.tracker.reject(req, reason)
+        raise InvalidMedia(reason, record=rec)
 
     def _dispatch_group(self, key: Key, requests: List[ExtractionRequest]) -> None:
         """One coalesced group -> one resident-extractor run over the
@@ -370,10 +399,17 @@ class ServeDaemon:
                         self._finish_done(r, ext)
                     else:
                         self.tracker.finish(r, "failed", **err)
-                # group-level failure: one breaker tick; a timed-out
-                # worker is abandoned, so its extractor must never be
-                # reused even if the breaker stays closed
-                if breaker.record_failure() or isinstance(exc, GroupTimeout):
+                # group-level failure: one breaker tick — UNLESS the
+                # crash is input-classified (corrupt media, resource
+                # caps). Hostile inputs fail their own requests but must
+                # not accumulate toward opening a healthy model's
+                # breaker: N corrupt uploads in a row is traffic, not an
+                # infra incident. A timed-out worker is abandoned, so
+                # its extractor must never be reused even if the
+                # breaker stays closed.
+                if faults.is_input_error(exc):
+                    breaker.record_ignored()
+                elif breaker.record_failure() or isinstance(exc, GroupTimeout):
                     self.pool.evict(feature_type)
                 return
             breaker.record_success()

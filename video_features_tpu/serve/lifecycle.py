@@ -71,6 +71,20 @@ class BadRequest(ValueError):
     the same way."""
 
 
+class InvalidMedia(BadRequest):
+    """The request was well-formed but its media failed the preflight
+    probe (io/probe.py): HTTP callers get 422 ``invalid_media`` with the
+    probe's reason, spool files quarantine via ``.bad``+``.why``, and —
+    unlike a plain BadRequest — the request had an identity, so a
+    durable ``rejected`` record is written before this is raised.
+    Permanent, input-classified: never a breaker tick, never a retry."""
+
+    def __init__(self, reason: str, record: Optional[Dict[str, Any]] = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.record = record or {}
+
+
 @dataclasses.dataclass
 class ExtractionRequest:
     """One admitted unit of work. ``bucket`` is the client's spatial-

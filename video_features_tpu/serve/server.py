@@ -32,7 +32,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Tuple
 
-from video_features_tpu.serve.lifecycle import BadRequest
+from video_features_tpu.serve.lifecycle import BadRequest, InvalidMedia
 
 MAX_BODY_BYTES = 1 << 20  # a request is a few hundred bytes; 1 MiB is hostile
 
@@ -73,6 +73,18 @@ class ServeHandler(BaseHTTPRequestHandler):
             return
         try:
             rec = daemon.submit(payload, source="http")
+        except InvalidMedia as exc:
+            # before the BadRequest catch (InvalidMedia IS a BadRequest):
+            # 422 says "well-formed request, unprocessable media" — the
+            # client should fix the FILE, not the request shape, and the
+            # durable rejected record rides along so the caller can poll
+            # /requests/<id> later and see the same terminal verdict
+            self._send(
+                422,
+                {"error": str(exc), "reason_code": "invalid_media",
+                 "record": exc.record},
+            )
+            return
         except BadRequest as exc:
             self._send(400, {"error": str(exc)})
             return

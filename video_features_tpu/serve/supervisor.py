@@ -124,6 +124,20 @@ class CircuitBreaker:
             self._failures = 0
             self._probing = False
 
+    def record_ignored(self) -> None:
+        """A group outcome that must not move the breaker either way:
+        input-classified crashes (corrupt media, resource caps) say
+        nothing about model health. In half-open this releases the
+        probe slot WITHOUT a verdict — the hostile input consumed the
+        probe group, so the next admitted group re-probes; the breaker
+        stays half-open rather than closing on unproven hardware or
+        re-opening on bad traffic. No-op when closed (the consecutive-
+        failure counter is neither advanced nor reset: an input error
+        between two real infra failures must not mask the streak, and
+        ignoring it is exactly the point)."""
+        with self._lock:
+            self._probing = False
+
     def record_failure(self) -> bool:
         """One group-level failure. Returns True when this failure
         (re)opened the breaker — the daemon's cue to tear the resident
