@@ -165,6 +165,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "device_preprocess": {"device_preprocess_fps": 11.0},
         "fault_overhead": {"fault_bookkeeping_us_per_video": 12.0},
         "analysis_overhead": {"analysis_graftcheck_cold_s": 0.7},
+        "preflight_overhead": {"preflight_us_per_video": 14.0},
         "telemetry_overhead": {"telemetry_overhead_us_per_video": 15.0},
         "serve_latency": {"serve_warm_request_s": 0.5},
         "serve_scheduling": {"serve_sched_edf_miss_rate": 0.0},
@@ -198,6 +199,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["host_pipeline"]["device_preprocess_fps"] == 11.0
     assert final["extra"]["fault_bookkeeping_us_per_video"] == 12.0
     assert final["extra"]["analysis_graftcheck_cold_s"] == 0.7
+    assert final["extra"]["preflight_us_per_video"] == 14.0
     assert final["extra"]["telemetry_overhead_us_per_video"] == 15.0
     assert final["extra"]["serve_warm_request_s"] == 0.5
     assert final["extra"]["serve_sched_edf_miss_rate"] == 0.0
@@ -232,6 +234,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"fault_bookkeeping_us_per_video": 12.0}
         if name == "analysis_overhead":  # pure-AST graftcheck sweep, no device
             return {"analysis_graftcheck_cold_s": 0.7}
+        if name == "preflight_overhead":  # probe micro-bench, pure host
+            return {"preflight_us_per_video": 14.0}
         if name == "telemetry_overhead":  # span engine micro-bench, CPU-pinned
             return {"telemetry_overhead_us_per_video": 15.0}
         if name == "serve_latency":  # serve admission bench, CPU-pinned

@@ -268,8 +268,9 @@ def test_clip_mesh_device_preprocess_parity(mixed_videos, tmp_path):
 
 def test_mesh_device_preprocess_sanity_gate():
     """sanity_check admits mesh+device for exactly the feature types whose
-    fused entry carries a GC502-checked sharding contract (CLIP today);
-    everything else still gets the actionable rejection."""
+    fused entry carries a GC502/GC504-checked sharding contract (CLIP,
+    RAFT/PWC flow, and two-stream I3D); everything else still gets the
+    actionable rejection."""
     from video_features_tpu.config import MESH_DEVICE_PREPROCESS_FEATURE_TYPES
 
     def cfg(ft, **kw):
@@ -284,8 +285,12 @@ def test_mesh_device_preprocess_sanity_gate():
         )
 
     assert "CLIP-ViT-B/32" in MESH_DEVICE_PREPROCESS_FEATURE_TYPES
+    assert {"raft", "pwc", "i3d"} <= set(MESH_DEVICE_PREPROCESS_FEATURE_TYPES)
     sanity_check(cfg("CLIP-ViT-B/32", extract_method="uni_4"))
-    for ft in ("resnet18", "raft"):
+    sanity_check(cfg("raft"))
+    sanity_check(cfg("pwc"))
+    sanity_check(cfg("i3d", flow_type="raft"))
+    for ft in ("resnet18", "resnet50"):
         with pytest.raises(ValueError, match="GC502"):
             sanity_check(cfg(ft))
     with pytest.raises(ValueError, match="mesh_context"):

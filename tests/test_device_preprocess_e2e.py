@@ -114,6 +114,97 @@ def test_flow_device_over_cap_streams_via_host_path(
     )
 
 
+@pytest.mark.parametrize("ft", ["raft", "pwc"])
+def test_flow_mesh_device_preprocess_parity(ft, tiny_flow_videos, tmp_path):
+    """--sharding mesh --preprocess device for the flow families: the
+    fused forward_raw under the declared payload contract (frame axis
+    'data', taps replicated, output replicated) against the queue path
+    on the same corpus. RAFT is bit-exact; PWC carries the pre-existing
+    ~2e-7 sharded-codegen drift of its in-model /64 stretch (the same
+    drift the HOST-path mesh shows vs queue — see test_parallel.py), so
+    it gets a tight allclose instead.
+
+    The run is also the {ft}_mesh_device_tiny GC401 scenario: mesh
+    placement must not add executables over the queue path's one."""
+    import jax
+
+    from video_features_tpu.analysis import CompileCounter, assert_within_budget
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    queue = _flow_run(ft, tiny_flow_videos, tmp_path / "q", "device")
+    from video_features_tpu.models.pwc.extract_pwc import ExtractPWC
+    from video_features_tpu.models.raft.extract_raft import ExtractRAFT
+
+    cls = ExtractRAFT if ft == "raft" else ExtractPWC
+    cfg = sanity_check(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type=ft,
+            video_paths=list(tiny_flow_videos),
+            batch_size=4,
+            preprocess="device",
+            sharding="mesh",
+            tmp_path=str(tmp_path / "m" / "tmp"),
+            output_path=str(tmp_path / "m" / "out"),
+            cpu=True,
+        )
+    )
+    with CompileCounter() as cc:
+        mesh = cls(cfg, external_call=True)(
+            device=make_mesh(jax.devices(), model=1)
+        )
+    assert_within_budget(f"{ft}_mesh_device_tiny", cc)
+    assert len(mesh) == len(queue) == 2
+    for m, q in zip(mesh, queue):
+        np.testing.assert_array_equal(m["timestamps_ms"], q["timestamps_ms"])
+        if ft == "raft":
+            np.testing.assert_array_equal(m[ft], q[ft])
+        else:
+            np.testing.assert_allclose(m[ft], q[ft], atol=1e-5, rtol=0)
+
+
+def test_i3d_mesh_device_preprocess_parity(sample_video, tmp_path):
+    """Two-stream I3D under --sharding mesh --preprocess device: the
+    per-stack fused entries (in-body sharding constraint on the uneven
+    S+1 frame axis, replicated output) are bit-exact against the queue
+    device path on both streams — and stay within the committed
+    i3d_mesh_device_two_stream compile budget."""
+    import jax
+
+    from video_features_tpu.analysis import CompileCounter, assert_within_budget
+    from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    def cfg(root, sharding):
+        return sanity_check(
+            ExtractionConfig(
+                allow_random_init=True,
+                feature_type="i3d",
+                video_paths=[sample_video],
+                flow_type="pwc",
+                extraction_fps=5.0,
+                stack_size=10,
+                step_size=10,
+                preprocess="device",
+                sharding=sharding,
+                tmp_path=str(root / "tmp"),
+                output_path=str(root / "out"),
+                cpu=True,
+            )
+        )
+
+    queue = ExtractI3D(cfg(tmp_path / "q", "queue"), external_call=True)([0])[0]
+    with CompileCounter() as cc:
+        mesh = ExtractI3D(cfg(tmp_path / "m", "mesh"), external_call=True)(
+            [0], device=make_mesh(jax.devices(), model=1)
+        )[0]
+    assert_within_budget("i3d_mesh_device_two_stream", cc)
+    for s in ("rgb", "flow"):
+        assert mesh[s].shape == queue[s].shape == (1, 1024)
+        np.testing.assert_array_equal(mesh[s], queue[s])
+    np.testing.assert_array_equal(mesh["timestamps_ms"], queue["timestamps_ms"])
+
+
 def test_i3d_device_two_stream_matches_host(sample_video, tmp_path):
     """Both I3D streams under --preprocess device: rgb rides crop-fused
     taps (fixed 224), pwc flow the exact-resized-shape contract. The

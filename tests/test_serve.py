@@ -639,6 +639,94 @@ def test_parse_serve_args_validation(tmp_path):
     assert scfg.extraction.on_extraction == "save_numpy"  # 'print' coerced
 
 
+# --- extractor pool: builds never hold the pool lock (GC312) -----------------
+
+
+def test_pool_get_builds_outside_the_pool_lock(tmp_path):
+    """The fixed GC312 finding, behaviorally: a slow first build must not
+    hold the pool lock — feature_types()/status() answer promptly
+    mid-build — and the loser of a build race waits on the latch and
+    reuses the winner's extractor (exactly one build)."""
+    import threading
+    from types import SimpleNamespace
+
+    from video_features_tpu.serve.daemon import ExtractorPool
+
+    scfg = parse_serve_args([
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu",
+    ])
+    release = threading.Event()
+    in_build = threading.Event()
+
+    def build(cfg):
+        in_build.set()
+        assert release.wait(30.0), "test never released the build"
+        return SimpleNamespace(
+            manifest=SimpleNamespace(record=lambda *a, **k: None),
+            telemetry=SimpleNamespace(close=lambda: None),
+        )
+
+    pool = ExtractorPool(scfg.extraction, scfg.max_group_size, build=build)
+    got = []
+    getters = [
+        threading.Thread(
+            target=lambda: got.append(pool.get("resnet18")), daemon=True
+        )
+        for _ in range(2)
+    ]
+    for t in getters:
+        t.start()
+    assert in_build.wait(30.0)
+    # the pool lock must be free while the build runs: this returns
+    # immediately (a regression re-serializing the build behind _lock
+    # deadlocks here until the pytest timeout)
+    assert pool.feature_types() == []
+    release.set()
+    for t in getters:
+        t.join(30.0)
+    assert len(got) == 2 and got[0] is got[1]
+    assert pool.build_count == {"resnet18": 1}
+    assert pool.feature_types() == ["resnet18"]
+
+
+def test_pool_failed_build_clears_latch_and_retries():
+    """A crashed builder must not wedge the latch: the next get() retries
+    from scratch instead of waiting forever on a latch nobody will set."""
+    from types import SimpleNamespace
+
+    from video_features_tpu.serve.daemon import ExtractorPool
+
+    calls = []
+
+    def build(cfg):
+        calls.append(cfg.feature_type)
+        if len(calls) == 1:
+            raise RuntimeError("weights missing")
+        return SimpleNamespace(
+            manifest=SimpleNamespace(record=lambda *a, **k: None),
+            telemetry=SimpleNamespace(close=lambda: None),
+        )
+
+    pool = ExtractorPool.__new__(ExtractorPool)
+    import threading
+    pool._cfg = None
+    pool._max_group_size = 1
+    pool._build = build
+    pool._lock = threading.Lock()
+    pool._extractors = {}
+    pool._building = {}
+    pool.build_count = {}
+    pool._serving_config = lambda ft: SimpleNamespace(feature_type=ft)
+    with pytest.raises(RuntimeError):
+        pool.get("resnet18")
+    assert pool._building == {}, "failed build must clear its latch"
+    assert pool.get("resnet18") is not None
+    assert pool.build_count == {"resnet18": 1}
+
+
 # --- graftcheck scope (satellite): serve/ is hot + thread-root ---------------
 
 
@@ -676,3 +764,30 @@ def test_shipped_serve_package_is_clean():
 
     fs = run_checks([os.path.join(package_root(), "serve")])
     assert fs == [], [f"{f.rule.id}:{f.path}:{f.line}" for f in fs]
+
+
+def test_pool_wait_under_lock_would_refire_gc312(tmp_path):
+    """Would-refire wire for the fixed pool finding: put the latch wait
+    back under the pool lock (untimed) and GC312 must fail the sweep —
+    proving both the fix and the rule are live on serve/daemon.py."""
+    from video_features_tpu.analysis import run_checks
+    from video_features_tpu.analysis.core import package_root
+
+    real = os.path.join(package_root(), "serve", "daemon.py")
+    with open(real, encoding="utf-8") as fh:
+        src = fh.read()
+    fixed = "latch.wait(1.0)"
+    assert fixed in src, "the off-lock timed latch wait must exist"
+    assert not run_checks([real], rules=["GC312"])
+    broken = tmp_path / "video_features_tpu" / "serve" / "daemon.py"
+    broken.parent.mkdir(parents=True)
+    broken.write_text(src.replace(
+        "            if not builder:\n"
+        "                latch.wait(1.0)",
+        "            if not builder:\n"
+        "                with self._lock:\n"
+        "                    latch.wait()",
+    ))
+    fs = run_checks([str(broken)], rules=["GC312"])
+    assert fs and all(f.rule.id == "GC312" for f in fs)
+    assert any("untimed .wait()" in f.message for f in fs)

@@ -93,6 +93,81 @@ def _parse_explain(spec: str) -> Tuple[str, Optional[str]]:
     return rule.strip(), (pathsub.strip() or None)
 
 
+def _repo_relative(path: str) -> str:
+    """SARIF artifact URIs are repo-relative so GitHub code scanning can
+    anchor annotations; fall back to the cwd when not in a git checkout."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except (subprocess.CalledProcessError, OSError):
+        top = os.getcwd()
+    rel = os.path.relpath(os.path.abspath(path), top)
+    return rel.replace(os.sep, "/")
+
+
+def _sarif(findings, rules) -> Dict[str, object]:
+    """SARIF 2.1.0 log: one run, the full rule catalogue in the driver
+    (so suppressed-to-zero runs still upload a valid ruleset), findings
+    as level=error results with the fix hint folded into the message."""
+    results = []
+    for f in findings:
+        message = f.message if not f.hint else f"{f.message} (fix: {f.hint})"
+        results.append(
+            {
+                "ruleId": f.rule.id,
+                "level": "error",
+                "message": {"text": message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": _repo_relative(f.path),
+                                "uriBaseId": "%SRCROOT%",
+                            },
+                            "region": {
+                                "startLine": f.line,
+                                # SARIF columns are 1-based; Finding.col
+                                # is the 0-based AST col_offset
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "version": "2.1.0",
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "informationUri": (
+                            "https://github.com/video-features-tpu/"
+                            "video-features-tpu/blob/main/docs/analysis.md"
+                        ),
+                        "rules": [
+                            {
+                                "id": r.id,
+                                "name": r.name,
+                                "shortDescription": {"text": r.summary},
+                            }
+                            for r in rules
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m video_features_tpu.analysis",
@@ -119,6 +194,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "(e.g. --explain GC102:extract_clip)",
     )
     parser.add_argument("--json", action="store_true", help="JSON findings")
+    parser.add_argument(
+        "--sarif", action="store_true",
+        help="SARIF 2.1.0 findings (GitHub code-scanning upload format)",
+    )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue"
     )
@@ -183,7 +262,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 1 if findings else 0
 
-    if args.json:
+    if args.sarif:
+        print(json.dumps(_sarif(findings, all_rules()), indent=2))
+    elif args.json:
         print(json.dumps([f.as_dict() for f in findings], indent=2))
     else:
         for f in findings:

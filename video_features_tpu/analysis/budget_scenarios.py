@@ -100,7 +100,15 @@ def _clip_run(tmp: str, video_batch: int) -> Dict[str, int]:
     return _counted(lambda: ExtractCLIP(cfg, external_call=True)())
 
 
-def _flow_run(tmp: str, ft: str) -> Dict[str, int]:
+def _mesh_device():
+    import jax
+
+    from video_features_tpu.parallel.sharding import make_mesh
+
+    return make_mesh(jax.devices(), model=1)
+
+
+def _flow_run(tmp: str, ft: str, mesh: bool = False) -> Dict[str, int]:
     from video_features_tpu.config import ExtractionConfig, sanity_check
 
     if ft == "raft":
@@ -118,15 +126,19 @@ def _flow_run(tmp: str, ft: str) -> Dict[str, int]:
             video_paths=_tiny_flow_videos(tmp),
             batch_size=4,
             preprocess="device",
+            sharding="mesh" if mesh else "queue",
             tmp_path=os.path.join(tmp, "tmp"),
             output_path=os.path.join(tmp, "out"),
             cpu=True,
         )
     )
+    if mesh:
+        dev = _mesh_device()
+        return _counted(lambda: cls(cfg, external_call=True)(device=dev))
     return _counted(lambda: cls(cfg, external_call=True)())
 
 
-def _i3d_run(tmp: str) -> Dict[str, int]:
+def _i3d_run(tmp: str, mesh: bool = False) -> Dict[str, int]:
     from video_features_tpu.config import ExtractionConfig, sanity_check
     from video_features_tpu.models.i3d.extract_i3d import ExtractI3D
     from video_features_tpu.utils.synth import synth_video
@@ -142,11 +154,17 @@ def _i3d_run(tmp: str) -> Dict[str, int]:
             stack_size=10,
             step_size=10,
             preprocess="device",
+            sharding="mesh" if mesh else "queue",
             tmp_path=os.path.join(tmp, "tmp"),
             output_path=os.path.join(tmp, "out"),
             cpu=True,
         )
     )
+    if mesh:
+        dev = _mesh_device()
+        return _counted(
+            lambda: ExtractI3D(cfg, external_call=True)([0], device=dev)
+        )
     return _counted(lambda: ExtractI3D(cfg, external_call=True)([0]))
 
 
@@ -197,6 +215,35 @@ SCENARIOS: Dict[str, Scenario] = {
         ),
         tracked=("rgb_fn", "flow_fn"),
         runner=lambda tmp: _i3d_run(tmp),
+    ),
+    "raft_mesh_device_tiny": Scenario(
+        description=(
+            "ExtractRAFT --sharding mesh --preprocess device on the tiny "
+            "flow corpus over the 8-virtual-device data mesh: the fused "
+            "forward_raw (frame axis sharded over 'data', taps replicated) "
+            "still compiles once — mesh placement must not add shapes."
+        ),
+        tracked=("forward_raw",),
+        runner=lambda tmp: _flow_run(tmp, "raft", mesh=True),
+    ),
+    "pwc_mesh_device_tiny": Scenario(
+        description=(
+            "ExtractPWC --sharding mesh --preprocess device on the same "
+            "tiny corpus: one fused forward_raw executable under the "
+            "GC504-checked payload sharding contract."
+        ),
+        tracked=("forward_raw",),
+        runner=lambda tmp: _flow_run(tmp, "pwc", mesh=True),
+    ),
+    "i3d_mesh_device_two_stream": Scenario(
+        description=(
+            "Two-stream ExtractI3D --sharding mesh --preprocess device "
+            "(flow_type=pwc) on the 320x240 synth clip: the per-stack "
+            "fused rgb_fn/flow_fn with in-body sharding constraints "
+            "compile once each for the single stack shape."
+        ),
+        tracked=("rgb_fn", "flow_fn"),
+        runner=lambda tmp: _i3d_run(tmp, mesh=True),
     ),
 }
 

@@ -378,6 +378,7 @@ def run_checks(
     package), drop waived findings, return the rest sorted by location.
     ``rules`` filters to findings whose rule id/name matches any token."""
     from video_features_tpu.analysis import (
+        concurrency,
         hostsync,
         jit_hygiene,
         sharding_contract,
@@ -388,7 +389,7 @@ def run_checks(
 
     sources = collect_sources(paths)
     # one call graph + taint context per sweep, shared by the
-    # interprocedural passes (GC10x, GC301, GC50x)
+    # interprocedural passes (GC10x, GC301, GC31x, GC50x)
     graph = CallGraph(sources)
     project = ProjectTaint(sources, graph)
     findings: List[Finding] = []
@@ -397,6 +398,7 @@ def run_checks(
             findings.extend(hostsync.check(src, project))
         findings.extend(jit_hygiene.check(src))
     findings.extend(thread_safety.check(sources, graph))
+    findings.extend(concurrency.check(sources, graph, project))
     findings.extend(sharding_contract.check(sources, graph))
 
     kept = []
@@ -413,6 +415,7 @@ def run_checks(
 
 def all_rules() -> List[Rule]:
     from video_features_tpu.analysis import (
+        concurrency,
         hostsync,
         jit_hygiene,
         sharding_contract,
@@ -424,6 +427,7 @@ def all_rules() -> List[Rule]:
         *hostsync.RULES.values(),
         *jit_hygiene.RULES.values(),
         thread_safety.RULE,
+        *concurrency.RULES.values(),
         BUDGET_RULE,
         *sharding_contract.RULES.values(),
     ]

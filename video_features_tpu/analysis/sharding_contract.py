@@ -41,6 +41,23 @@ Rules:
   helpers (``place_batch``/``place_params``/``place_raw_payload``),
   which attach NamedShardings; a direct call in an extractor places the
   whole batch on one device.
+- **GC504 mesh-fused-payload-roles** — GC502 proves the specs EXIST;
+  GC504 proves they say the right thing for the shape-contract payload:
+  the raw frame/stack batch the fused entry consumes must shard over
+  ``'data'`` (or be constrained inside the body via ``shard_seq``-style
+  ``with_sharding_constraint``), and every other payload input — the
+  banded resample taps, crop offsets, padder grids — must replicate
+  (``P()``). Specs are resolved through local ``NamedSharding(dev,
+  P(...))`` bindings and the ``fused_payload_shardings`` helper;
+  unresolvable specs are skipped, never guessed.
+- **GC505 mesh-admission-coverage** — the other direction of the
+  contract: every feature type ``config.py`` admits for ``--sharding
+  mesh --preprocess device`` (``MESH_DEVICE_PREPROCESS_FEATURE_TYPES``)
+  must map, through ``extract/registry.py``'s dispatch chain, to an
+  extractor module (or a module it directly imports) that declares at
+  least one mesh-reachable fused jit entry. Admitting a type whose
+  fused path is still ``not is_mesh``-gated would let ``sanity_check``
+  wave through a config the runtime cannot shard.
 """
 
 from __future__ import annotations
@@ -75,6 +92,17 @@ RULES = {
         "raw jax.device_put under mesh polarity bypasses the sharded "
         "placement helpers",
     ),
+    "GC504": Rule(
+        "GC504", "mesh-fused-payload-roles",
+        "a fused-preprocess in_shardings spec gives a shape-contract "
+        "payload the wrong role: frames shard over 'data', taps/offsets/"
+        "grids replicate",
+    ),
+    "GC505": Rule(
+        "GC505", "mesh-admission-coverage",
+        "a feature type admitted for --sharding mesh --preprocess device "
+        "has no mesh-reachable fused jit entry in its extractor module",
+    ),
 }
 
 _FUSED_ENTRIES = ("device_preprocess_frames", "device_resize_frames")
@@ -101,6 +129,7 @@ def check(sources: Sequence[SourceFile], graph: CallGraph) -> List[Finding]:
         if not _in_scope(src):
             continue
         findings.extend(_check_file(src))
+    findings.extend(_check_admission(sources, graph))
     return findings
 
 
@@ -126,9 +155,11 @@ def _in_scope(src: SourceFile) -> bool:
     return False
 
 
-def _check_file(src: SourceFile) -> List[Finding]:
+def _collect(src: SourceFile):
+    """All jit applications and raw device_put sites in one module, with
+    mesh polarity attached. Shared by the per-file rules (GC501-504) and
+    the admission-coverage pass (GC505)."""
     aliases = import_aliases(src.tree)
-    findings: List[Finding] = []
     apps: List[_JitApp] = []
     puts: List[tuple] = []  # (call, polarity)
 
@@ -246,8 +277,14 @@ def _check_file(src: SourceFile) -> List[Finding]:
                 scan_expr(child, cur, defs, display)
 
     visit_suite(src.tree.body, 0, {}, {})
+    return aliases, apps, puts
 
+
+def _check_file(src: SourceFile) -> List[Finding]:
+    aliases, apps, puts = _collect(src)
+    findings: List[Finding] = []
     splat_names = _sharding_splat_names(src.tree, aliases)
+    spec_env = _spec_env(src.tree, aliases)
 
     for app in apps:
         if app.polarity < 0:
@@ -282,6 +319,10 @@ def _check_file(src: SourceFile) -> List[Finding]:
                         "give every positional input an explicit spec (None "
                         "inherits from the placed argument)",
                     )
+                )
+            else:
+                findings.extend(
+                    _payload_role_findings(src, app, aliases, spec_env)
                 )
             continue
         if kwnames & {"in_shardings", "out_shardings"}:
@@ -399,3 +440,337 @@ def _inshardings_arity_gap(app: _JitApp):
             if given != expected:
                 return (given, expected)
     return None
+
+
+# --- GC504: payload-role classification -------------------------------------
+
+_DATA = "data"
+_REP = "rep"
+_AMBIG = "ambig"
+_PAYLOAD_HELPER = "fused_payload_shardings"
+
+
+def _classify_pspec(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """'data' / 'rep' / None for a ``PartitionSpec(...)`` call literal."""
+    rd = resolve_dotted(call.func, aliases)
+    if rd is None or rd.split(".")[-1] not in ("PartitionSpec", "P"):
+        return None
+    if not call.args and not call.keywords:
+        return _REP
+    for a in call.args:
+        if isinstance(a, ast.Constant) and a.value == "data":
+            return _DATA
+    return None  # sharded over some other axis / dynamic — don't judge
+
+
+def _classify_sharding_expr(expr: ast.AST,
+                            aliases: Dict[str, str]) -> Optional[str]:
+    if isinstance(expr, ast.Call):
+        rd = resolve_dotted(expr.func, aliases)
+        if (
+            rd is not None
+            and rd.split(".")[-1] == "NamedSharding"
+            and len(expr.args) >= 2
+            and isinstance(expr.args[1], ast.Call)
+        ):
+            return _classify_pspec(expr.args[1], aliases)
+    return None
+
+
+def _spec_env(tree: ast.AST, aliases: Dict[str, str]) -> Dict[str, str]:
+    """Name -> role for every sharding binding visible in the module:
+    ``batch_sh = NamedSharding(dev, P('data'))`` style assigns plus the
+    ``batch_sh, rep = fused_payload_shardings(dev)`` unpack idiom. A name
+    bound to conflicting roles anywhere in the file becomes ambiguous."""
+    env: Dict[str, str] = {}
+
+    def put(name: str, kind: Optional[str]) -> None:
+        if kind is None:
+            return
+        if name in env and env[name] != kind:
+            env[name] = _AMBIG
+        else:
+            env[name] = kind
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        if isinstance(tgt, ast.Name):
+            put(tgt.id, _classify_sharding_expr(val, aliases))
+        elif (
+            isinstance(tgt, ast.Tuple)
+            and len(tgt.elts) == 2
+            and all(isinstance(e, ast.Name) for e in tgt.elts)
+            and isinstance(val, ast.Call)
+        ):
+            rd = resolve_dotted(val.func, aliases)
+            if rd is not None and rd.split(".")[-1] == _PAYLOAD_HELPER:
+                put(tgt.elts[0].id, _DATA)
+                put(tgt.elts[1].id, _REP)
+    return env
+
+
+def _spec_kind(expr: ast.AST, env: Dict[str, str],
+               aliases: Dict[str, str]) -> Optional[str]:
+    """Role of one in_shardings tuple element; None when unresolvable
+    (never guess — an unknown spec is GC502's arity problem, not ours)."""
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return None  # inherits from the placed argument
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        kinds = [_spec_kind(e, env, aliases) for e in expr.elts]
+        if any(k == _DATA for k in kinds):
+            return _DATA
+        if kinds and all(k == _REP for k in kinds):
+            return _REP
+        return None
+    if isinstance(expr, ast.Name):
+        k = env.get(expr.id)
+        return None if k == _AMBIG else k
+    if isinstance(expr, ast.Call):
+        return _classify_sharding_expr(expr, aliases)
+    return None
+
+
+def _frames_param(fn: ast.FunctionDef, aliases: Dict[str, str]) -> Optional[str]:
+    """The positional parameter feeding the fused call's frame slot —
+    the first param name appearing inside the first argument of the
+    fused-entry call (covers both ``device_resize_frames(x, wy, wx)``
+    and the wrapped ``device_resize_frames(shard_seq(stack), ...)``)."""
+    params = set(param_names(fn))
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        rd = resolve_dotted(node.func, aliases)
+        if rd is not None and rd.split(".")[-1] in _FUSED_ENTRIES and node.args:
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Name) and sub.id in params:
+                    return sub.id
+    return None
+
+
+def _payload_role_findings(src: SourceFile, app: _JitApp,
+                           aliases: Dict[str, str],
+                           env: Dict[str, str]) -> List[Finding]:
+    fn = app.fn
+    spec = None
+    for kw in app.keywords:
+        if kw.arg == "in_shardings" and isinstance(kw.value, (ast.Tuple, ast.List)):
+            spec = kw.value
+    if spec is None or fn is None:
+        return []
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    if len(spec.elts) != len(pos):
+        return []  # arity gap — GC502 already owns that finding
+    frames = _frames_param(fn, aliases)
+    constrained = _body_constrained(fn, aliases)
+    out: List[Finding] = []
+    for name, elt in zip(pos, spec.elts):
+        kind = _spec_kind(elt, env, aliases)
+        if name == frames:
+            if kind == _REP and not constrained:
+                out.append(
+                    Finding(
+                        src.path, app.line, app.col, RULES["GC504"],
+                        f"fused entry {app.name!r} replicates its frame "
+                        f"batch {name!r} — the frame axis must shard over "
+                        f"'data' or the whole mesh recomputes every clip",
+                        "bind the frame input to NamedSharding(mesh, "
+                        "P('data')) (fused_payload_shardings gives the "
+                        "data/rep pair) or constrain it inside the body "
+                        "with with_sharding_constraint",
+                    )
+                )
+        elif kind == _DATA:
+            out.append(
+                Finding(
+                    src.path, app.line, app.col, RULES["GC504"],
+                    f"fused entry {app.name!r} shards shape-contract "
+                    f"payload {name!r} over 'data' — resample taps, crop "
+                    f"offsets and padder grids are per-shape metadata and "
+                    f"must replicate",
+                    "use P() (the rep half of fused_payload_shardings) for "
+                    "every non-frame payload input",
+                )
+            )
+    return out
+
+
+# --- GC505: admission-list coverage -----------------------------------------
+
+
+def _eval_strings(expr: ast.AST,
+                  consts: Dict[str, List[str]]) -> Optional[List[str]]:
+    """Mini-evaluator for the config string-list idiom: literal lists,
+    ``A + B`` concatenation, ``list(NAME)`` copies, and names bound to
+    earlier string lists. None when any part is dynamic."""
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        out: List[str] = []
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _eval_strings(expr.left, consts)
+        right = _eval_strings(expr.right, consts)
+        if left is not None and right is not None:
+            return left + right
+        return None
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "list"
+        and len(expr.args) == 1
+        and not expr.keywords
+    ):
+        return _eval_strings(expr.args[0], consts)
+    return None
+
+
+def _string_consts(src: SourceFile) -> Dict[str, List[str]]:
+    consts: Dict[str, List[str]] = {}
+    for st in src.tree.body:
+        if (
+            isinstance(st, ast.Assign)
+            and len(st.targets) == 1
+            and isinstance(st.targets[0], ast.Name)
+        ):
+            val = _eval_strings(st.value, consts)
+            if val is not None:
+                consts[st.targets[0].id] = val
+    return consts
+
+
+def _admitted_types(cfg: SourceFile,
+                    consts: Dict[str, List[str]]) -> tuple:
+    for st in cfg.tree.body:
+        if (
+            isinstance(st, ast.Assign)
+            and len(st.targets) == 1
+            and isinstance(st.targets[0], ast.Name)
+            and st.targets[0].id == "MESH_DEVICE_PREPROCESS_FEATURE_TYPES"
+        ):
+            return _eval_strings(st.value, consts) or [], st.lineno
+    return [], 0
+
+
+def _test_feature_types(test: ast.AST,
+                        consts: Dict[str, List[str]]) -> List[str]:
+    """Feature strings admitted by one registry dispatch test:
+    ``ft == "raft"``, ``ft in CLIP_FEATURE_TYPES``, or an ``or`` of those."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        out: List[str] = []
+        for v in test.values:
+            out.extend(_test_feature_types(v, consts))
+        return out
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        right = test.comparators[0]
+        if (
+            isinstance(test.ops[0], ast.Eq)
+            and isinstance(right, ast.Constant)
+            and isinstance(right.value, str)
+        ):
+            return [right.value]
+        if isinstance(test.ops[0], ast.In):
+            return _eval_strings(right, consts) or []
+    return []
+
+
+def _registry_modules(reg: SourceFile,
+                      consts: Dict[str, List[str]]) -> Dict[str, str]:
+    """feature type -> extractor module dotted path, from the lazy-import
+    dispatch chain in extract/registry.py."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(reg.tree):
+        if not isinstance(node, ast.If):
+            continue
+        fts = _test_feature_types(node.test, consts)
+        if not fts:
+            continue
+        mod = None
+        for st in node.body:
+            if isinstance(st, ast.ImportFrom) and st.module:
+                mod = st.module
+                break
+        if mod is None:
+            continue
+        for ft in fts:
+            out.setdefault(ft, mod)
+    return out
+
+
+def _direct_imports(src: SourceFile, graph: CallGraph) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    seen = {src.rel}
+    for node in ast.walk(src.tree):
+        mods: List[str] = []
+        if isinstance(node, ast.ImportFrom) and node.module:
+            mods.append(node.module)
+        elif isinstance(node, ast.Import):
+            mods.extend(a.name for a in node.names)
+        for m in mods:
+            hit = graph.resolve_module(m)
+            if hit is not None and hit.rel not in seen:
+                seen.add(hit.rel)
+                out.append(hit)
+    return out
+
+
+def _module_has_mesh_fused(src: SourceFile, cache: Dict[str, bool]) -> bool:
+    hit = cache.get(src.rel)
+    if hit is None:
+        aliases, apps, _ = _collect(src)
+        hit = any(
+            app.polarity >= 0
+            and app.fn is not None
+            and _calls_fused(app.fn, aliases)
+            for app in apps
+        )
+        cache[src.rel] = hit
+    return hit
+
+
+def _check_admission(sources: Sequence[SourceFile],
+                     graph: CallGraph) -> List[Finding]:
+    by_rel = {s.rel: s for s in sources}
+    cfg = by_rel.get("config.py")
+    reg = by_rel.get("extract/registry.py")
+    if cfg is None or reg is None:
+        return []  # single-file run: the admission facts are out of view
+    consts = _string_consts(cfg)
+    admitted, line = _admitted_types(cfg, consts)
+    if not admitted:
+        return []
+    consts.update(_string_consts(reg))
+    mapping = _registry_modules(reg, consts)
+    cache: Dict[str, bool] = {}
+    findings: List[Finding] = []
+    for ft in admitted:
+        mod = mapping.get(ft)
+        if mod is None:
+            continue  # dispatch not statically resolvable — never guess
+        target = graph.resolve_module(mod)
+        if target is None:
+            continue  # extractor module outside this sweep
+        if _module_has_mesh_fused(target, cache) or any(
+            _module_has_mesh_fused(m, cache)
+            for m in _direct_imports(target, graph)
+        ):
+            continue
+        findings.append(
+            Finding(
+                cfg.path, line, 0, RULES["GC505"],
+                f"feature type {ft!r} is admitted for --sharding mesh "
+                f"--preprocess device but its extractor module {mod!r} "
+                f"declares no mesh-reachable fused jit entry — sanity_check "
+                f"would wave through a config the runtime cannot shard",
+                "declare in_shardings/out_shardings on the family's fused "
+                "entry (see docs/tpu.md) before admitting it, or drop it "
+                "from MESH_DEVICE_PREPROCESS_FEATURE_TYPES",
+            )
+        )
+    return findings

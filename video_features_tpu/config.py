@@ -46,13 +46,16 @@ DEVICE_PREPROCESS_FEATURE_TYPES = (
 )
 
 # extractors whose fused --preprocess device entry also satisfies the
-# GC50x sharding contract under --sharding mesh: the frame-batch axis
+# GC50x sharding contract under --sharding mesh: the frame/stack axis
 # shards over 'data' with explicit in_shardings/out_shardings and the
-# resample taps replicate (models/clip/extract_clip.py encode_raw).
-# The other device-preprocess extractors keep their single-device fused
-# path (their _build guards it with `not is_mesh(device)`), so mesh+device
-# stays rejected for them until their entries carry the contract too.
-MESH_DEVICE_PREPROCESS_FEATURE_TYPES = list(CLIP_FEATURE_TYPES)
+# shape-contract payload (resample taps, crop offsets) replicates —
+# models/clip/extract_clip.py encode_raw, models/common/flow_extract.py
+# forward_raw, models/i3d/extract_i3d.py's fused mesh branch. The
+# remaining device-preprocess extractors (resnet*) keep their
+# single-device fused path, so mesh+device stays rejected for them until
+# their entries carry the contract too; graftcheck GC505 cross-checks
+# this list against the declared entries.
+MESH_DEVICE_PREPROCESS_FEATURE_TYPES = CLIP_FEATURE_TYPES + ["raft", "pwc", "i3d"]
 
 
 @dataclass

@@ -174,7 +174,7 @@ class PairwiseFlowExtractor(BaseExtractor):
             "device": device,
         }
 
-        if self._device_preprocess_enabled() and not is_mesh(device):
+        if self._device_preprocess_enabled():
             from video_features_tpu.ops.preprocess import device_resize_frames
 
             def forward_raw(p, x_u8, wy, wx):
@@ -184,19 +184,40 @@ class PairwiseFlowExtractor(BaseExtractor):
                 x = device_resize_frames(x_u8, wy, wx)
                 return model.apply({"params": p}, x)
 
-            def forward_raw_group(p, xs_u8, wy, wx):
-                # (G, B+1, bh, bw, 3) with PER-WINDOW (G, P, K) taps:
-                # mixed source resolutions fuse whenever they share the
-                # (input bucket, output grid, K) contract
-                x = device_resize_frames(xs_u8, wy, wx)
-                return jax.vmap(lambda w: model.apply({"params": p}, w))(x)
+            if is_mesh(device):
+                from video_features_tpu.parallel.sharding import (
+                    fused_payload_shardings,
+                )
 
-            fns["forward_raw"] = jax.jit(
-                forward_raw, **multihost_out_kwargs(device)
-            )
-            fns["forward_raw_group"] = jax.jit(
-                forward_raw_group, **multihost_out_kwargs(device)
-            )
+                # fused contract on the mesh: the raw frame axis shards
+                # over 'data' (the same sequence parallelism as the host
+                # path — dispatch_prepared mesh-fills the window first)
+                # and the banded taps replicate. Output pins REPLICATED:
+                # the B-pair axis is one short of the data-divisible
+                # frame axis, so a 'data' out spec would be rejected,
+                # and the all-gather is value-preserving — mesh stays
+                # bit-exact against queue.
+                batch_sh, rep = fused_payload_shardings(device)
+                fns["forward_raw"] = jax.jit(
+                    forward_raw,
+                    in_shardings=(None, batch_sh, (rep, rep), (rep, rep)),
+                    out_shardings=rep,
+                )
+            else:
+
+                def forward_raw_group(p, xs_u8, wy, wx):
+                    # (G, B+1, bh, bw, 3) with PER-WINDOW (G, P, K) taps:
+                    # mixed source resolutions fuse whenever they share
+                    # the (input bucket, output grid, K) contract
+                    x = device_resize_frames(xs_u8, wy, wx)
+                    return jax.vmap(lambda w: model.apply({"params": p}, w))(x)
+
+                fns["forward_raw"] = jax.jit(
+                    forward_raw, **multihost_out_kwargs(device)
+                )
+                fns["forward_raw_group"] = jax.jit(
+                    forward_raw_group, **multihost_out_kwargs(device)
+                )
 
         return fns
 
@@ -394,14 +415,18 @@ class PairwiseFlowExtractor(BaseExtractor):
 
         head, n_pairs, padder, fps, timestamps_ms = payload
         if isinstance(head, tuple) and head[0] == "dev":
-            # device contract: raw uint8 windows + shared taps (sanity
-            # rejects device+mesh, so no _mesh_fill here)
+            # device contract: raw uint8 windows + shared taps. On a mesh
+            # the taps replicate (per-shape metadata) and each window
+            # mesh-fills so its frame axis divides 'data' — matching the
+            # in_shardings the fused entry was jitted with.
+            from jax.sharding import PartitionSpec as P
+
             _, windows, wy, wx = head
-            wy = tuple(place_batch(a, state["device"]) for a in wy)
-            wx = tuple(place_batch(a, state["device"]) for a in wx)
+            wy = tuple(place_batch(a, state["device"], spec=P()) for a in wy)
+            wx = tuple(place_batch(a, state["device"], spec=P()) for a in wx)
             outs = []
             for w, n in zip(windows, n_pairs):
-                x = place_batch(w, state["device"])
+                x = place_batch(self._mesh_fill(state, w), state["device"])
                 outs.append(
                     (state["forward_raw"](state["params"], x, wy, wx), n)
                 )
@@ -441,6 +466,11 @@ class PairwiseFlowExtractor(BaseExtractor):
             return None
         head = payload[0]
         if isinstance(head, tuple) and head[0] == "dev":
+            # mesh ships only the solo fused entry (frame-axis sequence
+            # parallelism); the group path's window-axis DP would need its
+            # own sharding contract, so cross-video fusion stays queue-only
+            if self.config.sharding == "mesh":
+                return None
             _, windows, wy, wx = head
             if not windows:
                 return None

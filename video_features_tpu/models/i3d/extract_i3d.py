@@ -311,10 +311,11 @@ class ExtractI3D(BaseExtractor):
             # stacks, and crop offsets are all INPUTS, so jax.jit's own
             # shape cache compiles one executable per (input bucket,
             # output grid) contract rather than per source shape.
-            # sanity_check guarantees flow_type raft/pwc and no mesh for
-            # I3D device preprocess; the `not is_mesh` conjunct makes that
-            # visible to GC50x (these plain @jax.jit entries are
-            # single-device by construction).
+            # sanity_check guarantees flow_type raft/pwc for I3D device
+            # preprocess; the `not is_mesh` conjunct makes the
+            # single-device claim visible to GC50x (the fused MESH
+            # variants live in their own branch below with the full
+            # payload sharding contract declared).
             from video_features_tpu.ops.preprocess import (
                 device_resize_frames,
                 dynamic_center_crop,
@@ -368,6 +369,90 @@ class ExtractI3D(BaseExtractor):
                     return i3d.apply({"params": p_i3d}, f)
 
                 fns["flow"] = flow_fn
+
+            state["fns"][key] = fns
+            return fns
+
+        if key == ("dev",) and is_mesh(state["device"]):
+            # fused device preprocess ON the mesh: per-stack fns like the
+            # host-mesh branch below, but consuming raw uint8 stacks plus
+            # the shape-contract payload (taps, crop offsets). The full
+            # payload declares its sharding (GC502/GC504): every input
+            # replicates in — the taps and offsets are per-shape
+            # metadata, and the raw stack re-shards over 'data' via the
+            # in-body constraint, which tolerates the uneven S+1 frame
+            # axis — and outputs pin replicated so the single-stack
+            # feature row fetches whole.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from video_features_tpu.ops.preprocess import (
+                device_resize_frames,
+                dynamic_center_crop,
+            )
+
+            seq = NamedSharding(state["device"], P("data"))
+            rep = NamedSharding(state["device"], P())
+
+            if "rgb" in self.streams:
+
+                def rgb_fn(p, stack, wy, wx):
+                    # (S+1, bh, bw, 3) uint8 per stack; crop-fused taps
+                    # land the min-edge-256 resize + floor 224-crop in
+                    # one pass, sharded over the frame axis
+                    stack = jax.lax.with_sharding_constraint(stack, seq)
+                    x = device_resize_frames(stack[:-1], wy, wx)
+                    return i3d.apply({"params": p}, scale_to_1_1(x)[None])
+
+                fns["rgb"] = jax.jit(
+                    rgb_fn,
+                    in_shardings=(None, rep, (rep, rep), (rep, rep)),
+                    out_shardings=rep,
+                )
+
+            if "flow" in self.streams and self.flow_type == "raft":
+                from video_features_tpu.models.raft.model import build as raft_build
+
+                raft = raft_build(dtype=state.get("dtype", jnp.float32))
+
+                def flow_fn(p_flow, p_i3d, stack, wy, wx, fh, fw):
+                    # taps place the resized image on the /8 output
+                    # bucket with edge replication (InputPadder's pad is
+                    # inside the resize); the sharded frame axis gives
+                    # RAFT's pair views their GSPMD halo exchange
+                    stack = jax.lax.with_sharding_constraint(stack, seq)
+                    x = device_resize_frames(stack, wy, wx)
+                    flow = raft.apply({"params": p_flow}, x)  # (S, Hb, Wb, 2)
+                    f = dynamic_center_crop(flow, fh, fw, CENTRAL_CROP_SIZE)
+                    f = scale_to_1_1(flow_to_uint8(f))
+                    return i3d.apply({"params": p_i3d}, f[None])
+
+                fns["flow"] = jax.jit(
+                    flow_fn,
+                    in_shardings=(None, None, rep, (rep, rep), (rep, rep),
+                                  rep, rep),
+                    out_shardings=rep,
+                )
+            elif "flow" in self.streams and self.flow_type == "pwc":
+                from video_features_tpu.models.pwc.model import build as pwc_build
+
+                pwc = pwc_build(dtype=state.get("dtype", jnp.float32))
+
+                def flow_fn(p_flow, p_i3d, stack, wy, wx, fh, fw):
+                    # exact (oh, ow) contract — PWC's in-model /64
+                    # stretch must see the true resized geometry
+                    stack = jax.lax.with_sharding_constraint(stack, seq)
+                    x = device_resize_frames(stack, wy, wx)
+                    flow = pwc.apply({"params": p_flow}, x)
+                    f = dynamic_center_crop(flow, fh, fw, CENTRAL_CROP_SIZE)
+                    f = scale_to_1_1(flow_to_uint8(f))
+                    return i3d.apply({"params": p_i3d}, f[None])
+
+                fns["flow"] = jax.jit(
+                    flow_fn,
+                    in_shardings=(None, None, rep, (rep, rep), (rep, rep),
+                                  rep, rep),
+                    out_shardings=rep,
+                )
 
             state["fns"][key] = fns
             return fns
@@ -726,9 +811,14 @@ class ExtractI3D(BaseExtractor):
             n_valid = len(chunk)
             if mesh:  # per-stack, frame axis shards (sequence parallel)
                 start, end = chunk[0]
-                x = place_batch(
-                    np.stack(frames[start:end]), state["device"], spec=P()
-                )
+                stack = np.stack(frames[start:end])
+                if device_pre:
+                    # raw uint8 onto the input bucket — the fused mesh
+                    # fns' taps target the padded (bh, bw) grid
+                    from video_features_tpu.ops.window import pad_hw
+
+                    stack = pad_hw(stack, *geom["bucket"])
+                x = place_batch(stack, state["device"], spec=P())
                 fl = (
                     place_batch(flow_imgs[start:end], state["device"], spec=P())
                     if from_disk

@@ -210,6 +210,17 @@ def place_raw_payload(payload, device):
     return jax.device_put((frames, wy, wx), (batch, (rep, rep), (rep, rep)))
 
 
+def fused_payload_shardings(device):
+    """The (data, rep) NamedSharding pair for a fused device-preprocess
+    jit entry's payload roles: the raw frame/stack batch shards over
+    'data'; the shape-contract metadata riding along (banded resample
+    taps, crop offsets, padder grids) replicates — it is per-shape, not
+    per-frame, and kilobytes next to the frames. graftcheck GC504
+    resolves this helper by name, so declaring fused ``in_shardings``
+    through it keeps the payload roles statically provable."""
+    return NamedSharding(device, P("data")), NamedSharding(device, P())
+
+
 def place_batch(x, device, spec=P("data")):
     """Transfer one input batch: device_put for a single device, sharded
     device_put over the mesh (axis 0 must already divide — see

@@ -430,26 +430,38 @@ class Telemetry:
     def flush(self) -> None:
         """Drain buffered spans to the JSONL file and refresh the
         metrics snapshot. Called by the shared drain thread and by
-        :meth:`close`; safe from any thread."""
+        :meth:`close`; safe from any thread. ``_flush_lock`` serializes
+        WRITERS only — span recording contends on ``_lock`` alone, so a
+        slow disk never stalls the hot path — and the file I/O itself
+        lives in the ``_flush_sink`` boundary (the one sanctioned
+        blocking region, same contract as the GC10x fetch/sink
+        allowlist; GC312 holds every other lock region to it)."""
         with self._flush_lock:
             with self._lock:
                 rows = list(self._rows)
                 self._rows.clear()
-            if self._path is not None and rows:
-                if self._file is None:
-                    self._file = open(self._path, "a", encoding="utf-8")
-                f = self._file
-                for r in rows:
-                    f.write(json.dumps(r, default=str) + "\n")
-                f.flush()
-            if self._metrics_path is not None:
-                snap = self.metrics.snapshot()
-                snap["run"] = self.run_id
-                snap["buckets_seen"] = self.buckets_seen()
-                tmp = self._metrics_path + ".tmp"
-                with open(tmp, "w", encoding="utf-8") as f:
-                    json.dump(snap, f)
-                os.replace(tmp, self._metrics_path)
+            self._flush_sink(rows)
+
+    def _flush_sink(self, rows: List[Dict[str, Any]]) -> None:
+        """The blocking sink boundary: JSONL append + metrics snapshot
+        rewrite. Only ever entered with ``_flush_lock`` held (one writer
+        at a time); takes no state locks beyond the short ``_lock`` in
+        :meth:`buckets_seen`."""
+        if self._path is not None and rows:
+            if self._file is None:
+                self._file = open(self._path, "a", encoding="utf-8")
+            f = self._file
+            for r in rows:
+                f.write(json.dumps(r, default=str) + "\n")
+            f.flush()
+        if self._metrics_path is not None:
+            snap = self.metrics.snapshot()
+            snap["run"] = self.run_id
+            snap["buckets_seen"] = self.buckets_seen()
+            tmp = self._metrics_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self._metrics_path)
 
     def maybe_heartbeat(self) -> None:
         if self._next_heartbeat is None or time.monotonic() < self._next_heartbeat:

@@ -113,6 +113,9 @@ class ExtractorPool:
         self._build = build
         self._lock = threading.Lock()
         self._extractors: Dict[str, Any] = {}
+        # per-feature-type build latch: the winning builder publishes and
+        # sets it; losers wait OUTSIDE the pool lock (see get())
+        self._building: Dict[str, threading.Event] = {}
         self.build_count: Dict[str, int] = {}
 
     def _serving_config(self, feature_type: str) -> ExtractionConfig:
@@ -142,18 +145,40 @@ class ExtractorPool:
         return sanity_check(cfg)
 
     def get(self, feature_type: str) -> Any:
-        ext = self._extractors.get(feature_type)
-        if ext is None:
+        """Return the resident extractor, building it on first use.
+
+        The build (weights load + first jit compile) can take tens of
+        seconds and runs OUTSIDE ``_lock`` — GC312: anything queued on
+        the pool lock (``status()`` -> :meth:`feature_types`, eviction)
+        must never block behind it. One build per feature type is
+        serialized through a per-type latch; concurrent callers wait on
+        the latch (timed, off-lock) and re-check. A failed build clears
+        the latch so the next caller retries from scratch."""
+        while True:
             with self._lock:
                 ext = self._extractors.get(feature_type)
-                if ext is None:
-                    ext = self._build(self._serving_config(feature_type))
-                    ext.manifest = _OutcomeTee(ext.manifest)
+                if ext is not None:
+                    return ext
+                latch = self._building.get(feature_type)
+                builder = latch is None
+                if builder:
+                    latch = self._building[feature_type] = threading.Event()
+            if not builder:
+                latch.wait(1.0)  # poll: a crashed builder clears the latch
+                continue
+            try:
+                ext = self._build(self._serving_config(feature_type))
+                ext.manifest = _OutcomeTee(ext.manifest)
+                with self._lock:
                     self._extractors[feature_type] = ext
                     self.build_count[feature_type] = (
                         self.build_count.get(feature_type, 0) + 1
                     )
-        return ext
+                return ext
+            finally:
+                with self._lock:
+                    self._building.pop(feature_type, None)
+                latch.set()
 
     def feature_types(self) -> List[str]:
         with self._lock:
