@@ -1245,6 +1245,138 @@ def _sub_serve_scheduling() -> dict:
     return out
 
 
+def _sub_serve_cost_model() -> dict:
+    """Cost-aware scheduling (ISSUE 12): the pinned heterogeneous-cost
+    burst — one expensive group whose declared deadline is already
+    infeasible (10 s of service against a 5 s budget) ahead of eight
+    cheap feasible groups — dispatched under FIFO, plain EDF, and
+    edf-cost with a ServiceTimeModel trained from the same per-key
+    service times the simulation charges. Plain EDF runs the doomed
+    group first (earliest deadline) and dominoes every cheap deadline;
+    edf-cost demotes it behind the feasible work. The artifact is the
+    per-policy deadline-miss rate and p50/p99 latency; the tier-1 test
+    pins the same invariant (edf-cost strictly fewer misses at equal or
+    better p99). Pure host — no extractor, no jax."""
+    from video_features_tpu.serve.costmodel import ServiceTimeModel
+    from video_features_tpu.serve.lifecycle import ExtractionRequest
+    from video_features_tpu.serve.scheduler import (
+        CostAwareEdfScheduler,
+        EdfScheduler,
+        FifoScheduler,
+        simulate_dispatch,
+    )
+
+    heavy_s, cheap_s, n_cheap = 10.0, 0.5, 8
+
+    def burst():
+        groups = []
+        doomed = ExtractionRequest(
+            feature_type="i3d", video_path="/bench/big.mp4",
+            id="cost-doomed", bucket="big",
+        )
+        doomed.admitted_at, doomed.deadline_at = 0.0, 5.0
+        groups.append((("i3d", "big"), [doomed]))
+        for i in range(n_cheap):
+            req = ExtractionRequest(
+                feature_type="resnet18", video_path=f"/bench/v{i}.mp4",
+                id=f"cost-{i}", bucket=f"k{i}",
+            )
+            req.admitted_at, req.deadline_at = 0.0, 5.5 + 0.5 * i
+            groups.append((("resnet18", f"k{i}"), [req]))
+        return groups
+
+    def service(key, requests):
+        return heavy_s if key[0] == "i3d" else cheap_s
+
+    # train the estimator with exactly the service times the simulation
+    # charges (one observation pins the EWMA to the sample)
+    model = ServiceTimeModel()
+    model.observe("i3d", "big", 1, heavy_s)
+    for i in range(n_cheap):
+        model.observe("resnet18", f"k{i}", 1, cheap_s)
+
+    n = n_cheap + 1
+    out = {"serve_cost_burst_n": n, "serve_cost_heavy_s": heavy_s,
+           "serve_cost_cheap_s": cheap_s}
+    for name, sched in (
+        ("fifo", FifoScheduler()),
+        ("edf", EdfScheduler(default_slack_s=30.0, aging_s=10.0)),
+        ("edf_cost", CostAwareEdfScheduler(
+            model, default_slack_s=30.0, aging_s=10.0)),
+    ):
+        results = simulate_dispatch(burst(), sched, service_s=service)
+        missed = sum(1 for r in results if not r["met"])
+        lats = sorted(r["latency_s"] for r in results)
+        out[f"serve_cost_{name}_miss_rate"] = round(missed / n, 3)
+        out[f"serve_cost_{name}_p50_latency_s"] = round(lats[n // 2], 3)
+        out[f"serve_cost_{name}_p99_latency_s"] = round(lats[-1], 3)
+    out["serve_cost_edf_cost_saves"] = round(
+        out["serve_cost_edf_miss_rate"] - out["serve_cost_edf_cost_miss_rate"], 3
+    )
+    return out
+
+
+def _sub_metrics_endpoint_overhead() -> dict:
+    """/metrics exposition cost (ISSUE 12): time a full scrape — the
+    registry snapshot, family mapping, and text render, plus the HTTP
+    round trip — against the warm-request wall time on the same daemon.
+    The acceptance bound is render time < 1% of a warm request: the
+    observability surface must be free relative to the work it
+    observes."""
+    import urllib.request
+
+    from video_features_tpu.config import parse_serve_args
+    from video_features_tpu.serve.daemon import ServeDaemon
+    from video_features_tpu.utils.synth import synth_video
+
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        vid = synth_video(os.path.join(tmp, "v0.mp4"),
+                          n_frames=10, width=96, height=64, seed=0)
+        scfg = parse_serve_args([
+            "--feature_types", "resnet18",
+            "--output_path", os.path.join(tmp, "out"),
+            "--tmp_path", os.path.join(tmp, "tmp"),
+            "--allow_random_init", "--cpu", "--heartbeat_s", "0",
+            "--port", "0",
+        ])
+        d = ServeDaemon(scfg)
+        d.start()
+        seq = iter(range(10_000))
+
+        def run_one() -> float:
+            t0 = time.perf_counter()
+            d.submit({"feature_type": "resnet18", "video_path": vid,
+                      "bucket": "96x64", "id": f"mx-{next(seq)}"},
+                     source="local")
+            for g in d.batcher.take_ready(now=float("inf")):
+                d.batcher._run_group(g)
+            return time.perf_counter() - t0
+
+        run_one()  # cold: build + first jit, excluded
+        warm_s = min(run_one() for _ in range(3))
+        url = f"http://127.0.0.1:{d.http_port}/metrics"
+        urllib.request.urlopen(url, timeout=10).read()  # warm the socket path
+        n_scrapes = 50
+        t0 = time.perf_counter()
+        for _ in range(n_scrapes):
+            body = urllib.request.urlopen(url, timeout=10).read()
+        scrape_s = (time.perf_counter() - t0) / n_scrapes
+        # render-only (no HTTP): the in-process floor
+        t0 = time.perf_counter()
+        for _ in range(n_scrapes):
+            text = d.metrics_text()
+        render_s = (time.perf_counter() - t0) / n_scrapes
+        d.shutdown()
+        out["metrics_warm_request_s"] = round(warm_s, 4)
+        out["metrics_scrape_s"] = round(scrape_s, 6)
+        out["metrics_render_s"] = round(render_s, 6)
+        out["metrics_body_bytes"] = len(body)
+        out["metrics_render_over_request"] = round(render_s / max(warm_s, 1e-9), 5)
+        out["metrics_within_budget"] = render_s < 0.01 * warm_s
+    return out
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -1265,6 +1397,8 @@ SUB_PARTS = {
     "analysis_overhead": _sub_analysis_overhead,
     "serve_latency": _sub_serve_latency,
     "serve_scheduling": _sub_serve_scheduling,
+    "serve_cost_model": _sub_serve_cost_model,
+    "metrics_endpoint_overhead": _sub_metrics_endpoint_overhead,
 }
 
 

@@ -1,0 +1,599 @@
+"""Live serve observability (ISSUE 12): /metrics exposition, request
+tracing, SLO accounting, and the online service-time estimator.
+
+Layers under test, shallow to deep:
+
+- the pure exposition renderer + the in-repo format checker
+  (video_features_tpu/telemetry/exposition.py) — the checker is the
+  acceptance oracle, so it gets its own negative tests;
+- SloTracker and ServiceTimeModel units (fake clock / tmp paths, no
+  threads, no sleeps);
+- the edf-cost scheduler against the pinned heterogeneous-cost burst
+  (simulate_dispatch — the exact serial model the daemon loop runs);
+- daemon end-to-end with the ServeToy stub: GET /metrics validates
+  against the checker and carries the required series, /v1/stats is its
+  JSON twin, the heartbeat line reports live queue state, the
+  ``telemetry trace <request_id>`` CLI assembles one request's
+  admission -> queue_wait -> dispatch -> fetch -> sink timeline, and
+  SIGTERM reaches shutdown() (the lost-final-snapshot fix).
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from video_features_tpu.config import parse_serve_args
+from video_features_tpu.runtime.telemetry import MetricsRegistry, SloTracker
+from video_features_tpu.serve.costmodel import (
+    WEIGHT_CLASSES,
+    ServiceTimeModel,
+    default_model_path,
+    weight_class,
+)
+from video_features_tpu.serve.daemon import ServeDaemon, run_until_signalled
+from video_features_tpu.serve.lifecycle import ExtractionRequest
+from video_features_tpu.serve.scheduler import (
+    SCHEDULER_NAMES,
+    CostAwareEdfScheduler,
+    EdfScheduler,
+    FifoScheduler,
+    build_scheduler,
+    simulate_dispatch,
+)
+from video_features_tpu.telemetry.exposition import (
+    Family,
+    families_from_snapshot,
+    group_service_metric,
+    render_families,
+    sanitize_metric_name,
+    validate_exposition,
+)
+
+pytestmark = pytest.mark.serve
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# --- exposition renderer ----------------------------------------------------
+
+
+def test_render_families_counter_gauge_and_escaping():
+    c = Family("vft_requests_total", "counter", "Requests by state.")
+    c.add({"state": "done"}, 3)
+    g = Family("vft_queue_depth", "gauge", "Depth.")
+    g.add({"queue": 'we"ird\\path\nx'}, 1.5)
+    text = render_families([c, g])
+    assert text.endswith("\n")
+    assert 'vft_requests_total{state="done"} 3' in text
+    assert '{queue="we\\"ird\\\\path\\nx"}' in text
+    assert validate_exposition(text) == []
+
+
+def test_render_histogram_is_cumulative_with_inf():
+    m = MetricsRegistry()
+    for v in (0.0005, 0.02, 0.02, 5.0, 1e9):
+        m.observe("stage_s.decode", v)
+    text = render_families(families_from_snapshot(m.snapshot()))
+    assert validate_exposition(text) == []
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("vft_stage_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts)  # cumulative
+    assert 'le="+Inf"' in lines[-1] and counts[-1] == 5
+    assert "vft_stage_seconds_count" in text
+    assert "vft_stage_seconds_sum" in text
+
+
+def test_snapshot_mapping_conventions():
+    m = MetricsRegistry()
+    m.inc("requests_done", 2)
+    m.inc("requests_expired")
+    m.inc("deadline_missed")
+    m.set_gauge("queue_depth.admission", 4)
+    m.set_gauge("groups_inflight", 1)
+    m.observe(group_service_metric("CLIP-ViT-B/32", "640x480"), 0.7)
+    text = render_families(families_from_snapshot(m.snapshot()))
+    assert validate_exposition(text) == []
+    assert 'vft_requests_total{state="done"} 2' in text
+    assert 'vft_requests_total{state="expired"} 1' in text
+    assert "vft_deadline_missed_total 1" in text
+    assert 'vft_queue_depth{queue="admission"} 4' in text
+    assert "vft_groups_inflight 1" in text
+    # the '|' separator round-trips a feature type containing '/'
+    assert ('vft_group_service_seconds_count{bucket="640x480",'
+            'feature_type="CLIP-ViT-B/32"} 1') in text
+
+
+def test_sanitize_metric_name():
+    assert sanitize_metric_name("a.b/c-d") == "a_b_c_d"
+    assert sanitize_metric_name("9lives")[0] == "_"
+
+
+# --- exposition checker negatives (the acceptance oracle must bite) ---------
+
+
+def _errs(text):
+    return validate_exposition(text)
+
+
+def test_checker_rejects_missing_type():
+    assert _errs("vft_x 1\n")
+
+
+def test_checker_rejects_counter_without_total_suffix():
+    assert _errs("# HELP vft_x c\n# TYPE vft_x counter\nvft_x 1\n")
+
+
+def test_checker_rejects_noncumulative_histogram():
+    bad = (
+        "# HELP vft_h h\n# TYPE vft_h histogram\n"
+        'vft_h_bucket{le="0.1"} 5\nvft_h_bucket{le="1"} 3\n'
+        'vft_h_bucket{le="+Inf"} 5\nvft_h_sum 1\nvft_h_count 5\n'
+    )
+    assert _errs(bad)
+
+
+def test_checker_rejects_histogram_missing_inf_bucket():
+    bad = (
+        "# HELP vft_h h\n# TYPE vft_h histogram\n"
+        'vft_h_bucket{le="0.1"} 5\nvft_h_sum 1\nvft_h_count 5\n'
+    )
+    assert _errs(bad)
+
+
+def test_checker_rejects_count_disagreeing_with_inf():
+    bad = (
+        "# HELP vft_h h\n# TYPE vft_h histogram\n"
+        'vft_h_bucket{le="+Inf"} 5\nvft_h_sum 1\nvft_h_count 4\n'
+    )
+    assert _errs(bad)
+
+
+def test_checker_rejects_le_on_non_histogram():
+    assert _errs('# HELP vft_g g\n# TYPE vft_g gauge\nvft_g{le="1"} 1\n')
+
+
+def test_checker_rejects_bad_names_and_missing_newline():
+    assert _errs("# HELP 9bad x\n# TYPE 9bad gauge\n9bad 1\n")
+    assert _errs("# HELP vft_g g\n# TYPE vft_g gauge\nvft_g 1")  # no final \n
+    assert _errs('# HELP vft_g g\n# TYPE vft_g gauge\nvft_g{9l="x"} 1\n')
+
+
+# --- SloTracker -------------------------------------------------------------
+
+
+def test_slo_quantiles_and_tiers():
+    clock = FakeClock()
+    t = SloTracker(window_s=100.0, clock=clock)
+    for i in range(100):
+        t.record("done", latency_s=(i + 1) / 100.0, queue_wait_s=0.01,
+                 priority=0 if i < 50 else 3)
+    snap = t.snapshot()
+    assert snap["overall"]["count"] == 100
+    assert snap["overall"]["latency_s"]["p50"] == 0.5
+    assert snap["overall"]["latency_s"]["p99"] == 0.99
+    assert set(snap["tiers"]) == {"0", "3"}
+    assert snap["tiers"]["3"]["count"] == 50
+
+
+def test_slo_window_prunes_old_samples():
+    clock = FakeClock()
+    t = SloTracker(window_s=10.0, clock=clock)
+    t.record("done", latency_s=1.0)
+    clock.t = 100.0
+    t.record("done", latency_s=2.0)
+    snap = t.snapshot()
+    assert snap["overall"]["count"] == 1
+    assert snap["overall"]["latency_s"]["p50"] == 2.0
+
+
+def test_slo_miss_rate_denominator_excludes_cancelled_and_rejected():
+    t = SloTracker(window_s=100.0, clock=FakeClock())
+    t.record("done", latency_s=1.0, deadline_missed=False)
+    t.record("expired", latency_s=5.0, deadline_missed=True)
+    t.record("cancelled", latency_s=0.1)
+    t.record("rejected", latency_s=0.0)
+    assert t.miss_rate() == 0.5  # 1 missed / 2 in (done, expired)
+
+
+# --- ServiceTimeModel -------------------------------------------------------
+
+
+def test_cost_model_predict_fallback_chain(tmp_path):
+    m = ServiceTimeModel()
+    assert m.predict(("i3d", "640x480"), 4) == 0.0  # cold
+    m.observe("i3d", "640x480", 4, 8.0)  # 2 s/item
+    assert m.predict(("i3d", "640x480"), 2) == pytest.approx(4.0)
+    # same feature type, unseen bucket: feature-type fallback
+    assert m.predict(("i3d", "320x240"), 1) == pytest.approx(2.0)
+    # unseen feature type in the same weight class (heavy): class prior
+    assert m.predict(("raft", "~"), 1) == pytest.approx(2.0)
+    # unseen light model: global fallback (only heavy observed so far)
+    assert m.predict(("resnet18", "~"), 1) == pytest.approx(2.0)
+
+
+def test_cost_model_weight_classes_cover_every_feature_type():
+    from video_features_tpu.config import FEATURE_TYPES
+
+    for ft in FEATURE_TYPES:
+        assert ft in WEIGHT_CLASSES
+        assert weight_class(ft) in ("light", "medium", "heavy")
+
+
+def test_cost_model_persistence_roundtrip_and_torn_file(tmp_path):
+    path = str(tmp_path / "model.json")
+    m = ServiceTimeModel(path=path, save_every=1)
+    m.observe("resnet18", "64x48", 2, 1.0)
+    assert os.path.exists(path)
+    m2 = ServiceTimeModel(path=path)
+    assert m2.predict(("resnet18", "64x48"), 2) == pytest.approx(1.0)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write('{"torn')
+    m3 = ServiceTimeModel(path=path)  # torn file: cold start, no raise
+    assert m3.predict(("resnet18", "64x48"), 2) == 0.0
+
+
+def test_cost_model_default_path_prefers_compile_cache(tmp_path):
+    from video_features_tpu.config import ExtractionConfig
+
+    cfg = ExtractionConfig(
+        feature_type="resnet18",
+        output_path=str(tmp_path / "out"),
+        compile_cache=str(tmp_path / "cc"),
+    )
+    assert default_model_path(cfg) == str(
+        tmp_path / "cc" / "service_time_model.json"
+    )
+    cfg2 = cfg.replace(compile_cache=None)
+    assert default_model_path(cfg2) == str(
+        tmp_path / "out" / "_telemetry" / "service_time_model.json"
+    )
+
+
+# --- edf-cost scheduler -----------------------------------------------------
+
+
+def _burst():
+    """The pinned heterogeneous-cost burst: one 10 s group with a 5 s
+    budget (infeasible from admission) ahead of eight 0.5 s groups with
+    5.5..9 s budgets. Plain EDF serves the doomed group first and every
+    cheap deadline dominoes; edf-cost demotes it behind feasible work."""
+    groups = []
+    doomed = ExtractionRequest(feature_type="i3d", video_path="/x/big.mp4",
+                               id="doomed", bucket="big")
+    doomed.admitted_at, doomed.deadline_at = 0.0, 5.0
+    groups.append((("i3d", "big"), [doomed]))
+    for i in range(8):
+        r = ExtractionRequest(feature_type="resnet18", video_path=f"/x/{i}.mp4",
+                              id=f"c{i}", bucket=f"k{i}")
+        r.admitted_at, r.deadline_at = 0.0, 5.5 + 0.5 * i
+        groups.append((("resnet18", f"k{i}"), [r]))
+    return groups
+
+
+def _service(key, requests):
+    return 10.0 if key[0] == "i3d" else 0.5
+
+
+def _trained_model():
+    m = ServiceTimeModel()
+    m.observe("i3d", "big", 1, 10.0)
+    for i in range(8):
+        m.observe("resnet18", f"k{i}", 1, 0.5)
+    return m
+
+
+def test_edf_cost_beats_plain_edf_on_pinned_burst():
+    edf = simulate_dispatch(
+        _burst(), EdfScheduler(default_slack_s=30.0, aging_s=10.0),
+        service_s=_service,
+    )
+    cost = simulate_dispatch(
+        _burst(),
+        CostAwareEdfScheduler(_trained_model(), default_slack_s=30.0,
+                              aging_s=10.0),
+        service_s=_service,
+    )
+    edf_miss = sum(1 for r in edf if not r["met"])
+    cost_miss = sum(1 for r in cost if not r["met"])
+    assert edf_miss == 9  # the doomed group dominoes everything
+    assert cost_miss == 1  # only the infeasible group itself
+    # equal-or-better p99 (the doomed group still has to run somewhere)
+    assert max(r["latency_s"] for r in cost) <= max(r["latency_s"] for r in edf)
+
+
+def test_edf_cost_consults_the_model():
+    class Recorder:
+        def __init__(self):
+            self.calls = []
+
+        def predict(self, key, n):
+            self.calls.append((key, n))
+            return 0.0
+
+    rec = Recorder()
+    sched = CostAwareEdfScheduler(rec)
+    groups = _burst()
+    sched.pick(groups, now=0.0)
+    assert rec.calls  # acceptance: edf-cost ranks via model.predict
+    assert (("i3d", "big"), 1) in rec.calls
+
+
+def test_cold_model_degenerates_to_plain_edf():
+    edf = simulate_dispatch(
+        _burst(), EdfScheduler(default_slack_s=30.0, aging_s=10.0),
+        service_s=_service,
+    )
+    cold = simulate_dispatch(
+        _burst(), CostAwareEdfScheduler(ServiceTimeModel(),
+                                        default_slack_s=30.0, aging_s=10.0),
+        service_s=_service,
+    )
+    assert [r["id"] for r in cold] == [r["id"] for r in edf]
+
+
+def test_build_scheduler_names():
+    assert set(SCHEDULER_NAMES) == {"edf", "fifo", "edf-cost"}
+    assert isinstance(build_scheduler("fifo"), FifoScheduler)
+    assert type(build_scheduler("edf")) is EdfScheduler
+    s = build_scheduler("edf-cost", cost_model=_trained_model())
+    assert isinstance(s, CostAwareEdfScheduler)
+    assert build_scheduler("edf-cost").predicted_service_s(
+        _burst()[0], now=0.0
+    ) == 0.0  # default-constructed model is cold, not None
+
+
+# --- daemon end to end (ServeToy, inline drain) -----------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    d = tmp_path_factory.mktemp("obs_media")
+    return [
+        synth_video(str(d / f"v{i}.mp4"), n_frames=10, width=64, height=48,
+                    seed=i)
+        for i in range(3)
+    ]
+
+
+def _daemon(tmp_path, **flags):
+    from test_serve import ServeToy
+
+    argv = [
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu",
+        "--heartbeat_s", "0",
+    ]
+    for k, v in flags.items():
+        argv += [f"--{k}"] + ([str(v)] if v is not True else [])
+    scfg = parse_serve_args(argv)
+
+    class Toy(ServeToy):
+        built = 0
+
+    return ServeDaemon(scfg, build=Toy)
+
+
+def _drain(d):
+    for g in d.batcher.take_ready(now=float("inf")):
+        d.batcher._run_group(g)
+
+
+def _submit(d, video, rid, **extra):
+    d.submit({"feature_type": "resnet18", "video_path": video, "id": rid,
+              **extra}, source="local")
+
+
+@pytest.fixture(scope="module")
+def obs_run(tmp_path_factory, obs_videos):
+    """One served 2-request burst (fused group path: dispatch/fetch
+    spans exist) behind a live HTTP door, shared by the endpoint,
+    heartbeat, and trace tests."""
+    tmp = tmp_path_factory.mktemp("obs_run")
+    d = _daemon(tmp, port=0, scheduler="edf-cost")
+    d.start()
+    for i in range(2):
+        _submit(d, obs_videos[i], f"obs{i}", bucket="64x48", priority=2,
+                deadline_ms=600000)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        states = [
+            (d.tracker.get(f"obs{i}") or {}).get("state") for i in range(2)
+        ]
+        if all(s in ("done", "failed") for s in states):
+            break
+        time.sleep(0.02)
+    assert states == ["done", "done"]
+    yield d, tmp
+    if d._http_server is not None:
+        d.shutdown()
+
+
+def _get(d, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{d.http_port}{path}", timeout=10
+    )
+
+
+def test_metrics_endpoint_is_valid_exposition(obs_run):
+    d, _ = obs_run
+    resp = _get(d, "/metrics")
+    assert resp.headers["Content-Type"] == "text/plain; version=0.0.4; charset=utf-8"
+    text = resp.read().decode("utf-8")
+    assert validate_exposition(text) == []
+    # the acceptance series
+    assert 'vft_queue_depth{queue="admission"}' in text
+    assert ('vft_group_service_seconds_count{bucket="64x48",'
+            'feature_type="resnet18"} 1') in text
+    assert 'vft_requests_total{state="done"} 2' in text
+    assert "vft_deadline_missed" not in text or "vft_deadline_missed_total 0" in text
+    assert 'vft_breaker_state{feature_type="resnet18"} 0' in text
+    assert 'vft_slo_latency_seconds{quantile="0.99",tier="overall"}' in text
+    assert 'vft_slo_deadline_miss_ratio{tier="2"} 0' in text
+    assert "vft_groups_dispatched_total 1" in text
+    assert "vft_uptime_seconds" in text
+    assert "vft_queue_age_oldest_s 0" in text
+
+
+def test_stats_endpoint_is_the_json_twin(obs_run):
+    d, _ = obs_run
+    st = json.load(_get(d, "/v1/stats"))
+    assert st["slo"]["overall"]["count"] == 2
+    assert st["slo"]["overall"]["miss_rate"] == 0.0
+    assert st["slo"]["tiers"]["2"]["latency_s"]["p99"] > 0
+    assert st["cost_model"]["keys"]["resnet18|64x48"]["n"] == 1
+    assert st["metrics"]["counters"]["requests_done"] == 2
+    assert st["uptime_s"] > 0
+    assert st["queue_depth"] == 0  # /healthz fields ride along
+
+
+def test_heartbeat_line_reports_live_serve_state(obs_run):
+    d, _ = obs_run
+    line = d._heartbeat_line()
+    assert line.startswith("serve: queue=0 ")
+    assert "inflight=0" in line
+    assert "miss_rate=0.0%" in line
+    assert "completed/s=" in line
+    # the provider is wired into the daemon's telemetry drain loop
+    # (== not `is`: bound methods are recreated per attribute access)
+    assert d.telemetry.heartbeat_provider == d._heartbeat_line
+
+
+def test_queue_wait_span_and_record(obs_run):
+    d, _ = obs_run
+    rec = d.tracker.get("obs0")
+    assert rec["queue_wait_s"] >= 0.0
+    spans = [s for s in d.telemetry.spans() if s["stage"] == "queue_wait"]
+    assert {s["request"] for s in spans} == {"obs0", "obs1"}
+    by_req = {s["request"]: s for s in spans}
+    # pinned under the request span, annotated with the fused group size
+    req_spans = {s["request"]: s for s in d.telemetry.spans()
+                 if s["stage"] == "request"}
+    assert by_req["obs0"]["parent"] == req_spans["obs0"]["span"]
+    assert by_req["obs0"]["group_size"] == 2
+
+
+def test_trace_cli_covers_request_lifecycle(obs_run, tmp_path, capsys):
+    from video_features_tpu.telemetry.__main__ import main as tele_main
+
+    d, run_tmp = obs_run
+    # two telemetry instances, two spans files: the daemon's lifecycle
+    # spans and the resident extractor's pipeline spans
+    d.telemetry.flush()
+    d.pool._extractors["resnet18"].telemetry.flush()
+    out = tmp_path / "trace.json"
+    root = str(run_tmp / "out")
+    assert tele_main(["trace", "obs0", root, "-o", str(out)]) == 0
+    trace = json.loads(out.read_text())
+    stages = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    # the acceptance path: admission -> queue_wait -> dispatch -> fetch
+    # -> sink, plus the linking request spans
+    assert {"admission", "queue_wait", "request",
+            "dispatch", "fetch", "sink"} <= stages
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    # unknown ids are a usage error, not an empty trace
+    assert tele_main(["trace", "no-such-request", root]) == 2
+    assert "no spans mention" in capsys.readouterr().err
+
+
+def test_expired_request_counts_as_deadline_miss(tmp_path, obs_videos):
+    d = _daemon(tmp_path, max_batch_wait_ms=0)
+    try:
+        _submit(d, obs_videos[0], "late", deadline_ms=0.001)
+        time.sleep(0.01)  # let the 1 µs budget pass on the real clock
+        _drain(d)
+        rec = d.tracker.get("late")
+        assert rec["state"] == "expired"
+        assert rec["deadline_missed"] is True
+        assert d.telemetry.metrics.counter("deadline_missed") == 1
+        assert d.slo.miss_rate() == 1.0
+        text = d.metrics_text()
+        assert validate_exposition(text) == []
+        assert "vft_deadline_missed_total 1" in text
+        assert 'vft_requests_total{state="expired"} 1' in text
+    finally:
+        d.shutdown()
+
+
+def test_dispatch_feeds_cost_model_and_persists_on_shutdown(
+    tmp_path, obs_videos
+):
+    d = _daemon(tmp_path)
+    _submit(d, obs_videos[0], "cm0", bucket="64x48")
+    _drain(d)
+    assert d.tracker.get("cm0")["state"] == "done"
+    assert d.cost_model.predict(("resnet18", "64x48"), 1) > 0.0
+    d.shutdown()
+    path = default_model_path(d.cfg)
+    assert os.path.exists(path)
+    reloaded = ServiceTimeModel(path=path)
+    assert reloaded.predict(("resnet18", "64x48"), 1) > 0.0
+
+
+def test_sigterm_reaches_shutdown(tmp_path, obs_videos):
+    """The lost-final-snapshot fix: `kill <pid>` must drain and run
+    shutdown() — spans flushed, summary written — not die mid-flight."""
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal handlers need the main thread")
+    d = _daemon(tmp_path)
+    _submit(d, obs_videos[0], "sig0")
+    _drain(d)
+    timer = threading.Timer(0.2, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        run_until_signalled(d)  # returns only because the handler fired
+    finally:
+        timer.cancel()
+    troot = os.path.join(str(tmp_path / "out"), "_telemetry")
+    spans = []
+    for name in os.listdir(troot):
+        if name.startswith("spans-") and name.endswith(".jsonl"):
+            with open(os.path.join(troot, name), "r", encoding="utf-8") as fh:
+                spans += [json.loads(ln) for ln in fh if ln.strip()]
+    assert any(s["stage"] == "request" for s in spans)  # final flush landed
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "out"), "_manifest", "summary.json")
+    )
+
+
+# --- graftcheck scope (satellite: new module, zero waivers) -----------------
+
+
+def test_costmodel_in_graftcheck_scope_no_waivers():
+    import fnmatch
+
+    from video_features_tpu.analysis.core import (
+        HOT_MODULE_PATTERNS,
+        THREAD_ROOT_PATTERNS,
+    )
+
+    assert any(fnmatch.fnmatch("serve/costmodel.py", p)
+               for p in HOT_MODULE_PATTERNS)
+    assert any(fnmatch.fnmatch("serve/costmodel.py", p)
+               for p in THREAD_ROOT_PATTERNS)
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("video_features_tpu/serve/costmodel.py",
+                "video_features_tpu/telemetry/exposition.py",
+                "video_features_tpu/serve/batcher.py",
+                "video_features_tpu/serve/daemon.py",
+                "video_features_tpu/serve/server.py",
+                "video_features_tpu/serve/lifecycle.py"):
+        with open(os.path.join(pkg, rel), "r", encoding="utf-8") as fh:
+            assert "graftcheck:" not in fh.read(), rel

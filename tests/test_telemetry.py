@@ -290,6 +290,52 @@ def test_overlap_report_ignores_junk_rows():
     assert rep["device_busy_s"] == 0.0
 
 
+def test_report_zero_device_stage_spans_no_division_crash():
+    # a host-only run (decode smoke test, serve lifecycle spans only):
+    # device busy is 0 and both ratios must degrade to 0.0, not ZeroDivision
+    rep = tm.overlap_report([
+        _row("decode", 0.0, 2.0),
+        _row("prepare", 1.0, 3.0),
+    ])
+    assert rep["device_busy_s"] == 0.0
+    assert rep["overlap_s"] == 0.0
+    assert rep["overlap_efficiency"] == 0.0
+    assert rep["overlap_of_device"] == 0.0
+    assert rep["wall_s"] == pytest.approx(3.0)
+
+
+def test_report_cli_zero_device_spans(tmp_path, capsys):
+    # end to end through the CLI: a spans file with no device-stage rows
+    # still reports (the ratios are 0.0%, not an error)
+    f = tmp_path / "spans-host.jsonl"
+    f.write_text(json.dumps({
+        "span": "r.1", "seq": 1, "stage": "decode", "t0": 0.0, "t1": 1.0,
+        "pid": 1, "run": "r", "thread": 1, "thread_name": "MainThread",
+    }) + "\n")
+    assert tele_main(["report", str(f), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["device_busy_s"] == 0.0 and rep["overlap_of_device"] == 0.0
+
+
+def test_report_single_pid_wall_is_one_window():
+    # all spans in one pid: wall is one min->max window, not a sum
+    rep = tm.overlap_report([
+        _row("prepare", 0.0, 1.0, pid=7),
+        _row("dispatch", 10.0, 11.0, pid=7),
+    ])
+    assert rep["wall_s"] == pytest.approx(11.0)
+    assert rep["overlap_s"] == 0.0
+
+
+def test_report_cli_empty_spans_file_is_usage_error(tmp_path, capsys):
+    # an existing-but-empty spans file (a run that died before the first
+    # flush) is "no spans", exit 2 — same as a missing directory
+    f = tmp_path / "spans-empty.jsonl"
+    f.write_text("")
+    assert tele_main(["report", str(f)]) == 2
+    assert "no spans" in capsys.readouterr().err
+
+
 def test_chrome_trace_from_synthetic_rows():
     rows = [
         {"span": "r.1", "stage": "prepare", "video": "v", "t0": 10.0, "t1": 10.5,

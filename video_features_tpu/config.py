@@ -661,13 +661,18 @@ class ServeConfig:
     max_group_size: int = 8
     max_queue: int = 256
     # cross-key dispatch scheduling (serve/scheduler.py): EDF with
-    # priority tiers and aging by default; "fifo" is the A/B baseline.
-    # default_slack_ms is the effective deadline assigned to requests
-    # that declare none; aging_ms is one priority-tier boost per that
-    # much queue wait (0 disables aging)
+    # priority tiers and aging by default; "fifo" is the A/B baseline;
+    # "edf-cost" additionally consults the online service-time model
+    # (serve/costmodel.py) to demote infeasible groups and rank by
+    # latest start time. default_slack_ms is the effective deadline
+    # assigned to requests that declare none; aging_ms is one priority-
+    # tier boost per that much queue wait (0 disables aging)
     scheduler: str = "edf"
     default_slack_ms: float = 30000.0
     aging_ms: float = 10000.0
+    # rolling window for the SLO tracker behind /metrics, /v1/stats,
+    # and the heartbeat's deadline-miss rate
+    slo_window_s: float = 300.0
     # supervision (serve/supervisor.py): bound on one group's extraction
     # wall time (0 = unbounded), and the per-feature-type circuit
     # breaker (open after `threshold` consecutive group-level failures,
@@ -738,10 +743,13 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
     g.add_argument("--max_queue", type=int, default=256,
                    help="admission bound: requests admitted but not yet "
                         "terminal; past it new requests get 503/rejected")
-    g.add_argument("--scheduler", choices=("edf", "fifo"), default="edf",
+    g.add_argument("--scheduler", choices=("edf", "fifo", "edf-cost"),
+                   default="edf",
                    help="cross-key dispatch order: earliest-effective-"
                         "deadline-first with priority tiers and aging "
-                        "(default), or plain arrival order")
+                        "(default), plain arrival order, or cost-aware "
+                        "EDF that consults the online service-time "
+                        "model to skip infeasible groups")
     g.add_argument("--default_slack_ms", type=float, default=30000.0,
                    help="effective deadline assigned to requests that "
                         "declare no deadline_ms (EDF ranking only; "
@@ -750,6 +758,10 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                    help="one priority-tier boost per this much queue "
                         "wait, so low-priority work cannot starve "
                         "(0 disables aging)")
+    g.add_argument("--slo_window_s", type=float, default=300.0,
+                   help="rolling window (seconds) for the SLO tracker's "
+                        "latency quantiles and deadline-miss rate "
+                        "(/metrics, /v1/stats, heartbeat)")
     g.add_argument("--group_timeout_s", type=float, default=0.0,
                    help="watchdog bound on one group's extraction wall "
                         "time; on timeout the group fails transient and "
@@ -803,6 +815,7 @@ def parse_serve_args(argv: Optional[Sequence[str]] = None) -> ServeConfig:
         scheduler=args.scheduler,
         default_slack_ms=args.default_slack_ms,
         aging_ms=args.aging_ms,
+        slo_window_s=args.slo_window_s,
         group_timeout_s=args.group_timeout_s,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s,
@@ -831,12 +844,16 @@ def sanity_check_serve(scfg: ServeConfig) -> ServeConfig:
         raise ValueError(f"max_batch_wait_ms must be >= 0, got {scfg.max_batch_wait_ms}")
     if scfg.spool_poll_s <= 0:
         raise ValueError(f"spool_poll_s must be > 0, got {scfg.spool_poll_s}")
-    if scfg.scheduler not in ("edf", "fifo"):
-        raise ValueError(f"scheduler must be 'edf' or 'fifo', got {scfg.scheduler!r}")
+    if scfg.scheduler not in ("edf", "fifo", "edf-cost"):
+        raise ValueError(
+            f"scheduler must be 'edf', 'fifo', or 'edf-cost', got {scfg.scheduler!r}"
+        )
     if scfg.default_slack_ms <= 0:
         raise ValueError(f"default_slack_ms must be > 0, got {scfg.default_slack_ms}")
     if scfg.aging_ms < 0:
         raise ValueError(f"aging_ms must be >= 0, got {scfg.aging_ms}")
+    if scfg.slo_window_s <= 0:
+        raise ValueError(f"slo_window_s must be > 0, got {scfg.slo_window_s}")
     if scfg.group_timeout_s < 0:
         raise ValueError(f"group_timeout_s must be >= 0, got {scfg.group_timeout_s}")
     if scfg.breaker_threshold < 1:

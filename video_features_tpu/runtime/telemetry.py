@@ -43,6 +43,7 @@ import bisect
 import glob
 import io
 import json
+import math
 import os
 import sys
 import threading
@@ -59,14 +60,17 @@ STAGES = (
     "decode", "reencode", "prepare", "h2d",
     "dispatch", "fetch", "sink", "compile", "extract",
     "request",  # serve mode: one request's lifetime, parent of its group's stages
+    "admission",   # serve mode: parse + preflight + queue admit of one request
+    "queue_wait",  # serve mode: admission -> group dispatch (the queueing delay)
 )
 
 # Host-side ingest stages vs device dispatch/fetch stages, for the
 # overlap-efficiency report. ``extract`` (the serial loop's fused
 # prepare+device stage) is deliberately in neither set: the serial loop
-# has no overlap story to measure. ``request`` is in neither either —
-# it brackets queueing + dispatch end-to-end, so counting it as busy
-# time in either set would double-book its children.
+# has no overlap story to measure. The serve lifecycle stages
+# (``request``/``admission``/``queue_wait``) are in neither either —
+# they bracket queueing + dispatch end-to-end, so counting them as busy
+# time in either set would double-book their children.
 HOST_STAGES = frozenset({"decode", "reencode", "prepare"})
 DEVICE_STAGES = frozenset({"h2d", "dispatch", "fetch"})
 
@@ -217,6 +221,113 @@ class MetricsRegistry:
             }
 
 
+def _quantile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile over an ascending list (empty -> 0.0)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(math.ceil(q * len(sorted_vals)) - 1, 0)
+    return float(sorted_vals[min(idx, len(sorted_vals) - 1)])
+
+
+class SloTracker:
+    """Rolling-window SLO accounting for serve mode (ISSUE 12).
+
+    One sample per terminal request: end-to-end latency (admission to
+    terminal, on the daemon's scheduling clock), queue wait, priority
+    tier, terminal state, and whether its deadline was missed. The
+    window is time-bounded (``window_s``) and size-bounded
+    (``max_samples``), so a week-old burst never skews today's p99 and
+    memory stays O(1) under any traffic.
+
+    ``snapshot()`` feeds /metrics, /v1/stats, and the serve heartbeat
+    line: p50/p95/p99 latency + queue wait and deadline-miss rate,
+    overall and per priority tier. The miss-rate denominator counts only
+    requests that were *supposed* to complete (done/failed/expired);
+    cancelled and rejected requests still contribute latency samples but
+    a user hitting DELETE is not a missed promise.
+
+    Thread-safe (records arrive from the dispatcher thread, snapshots
+    from HTTP handler threads and the drain-thread heartbeat); no I/O
+    under the lock."""
+
+    # terminal states that count toward the deadline-miss denominator
+    _MISS_DENOM_STATES = ("done", "failed", "expired")
+
+    def __init__(
+        self,
+        window_s: float = 300.0,
+        max_samples: int = 4096,
+        clock: Any = time.monotonic,
+    ) -> None:
+        self.window_s = max(float(window_s), 1.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (t, tier, state, latency_s, queue_wait_s|None, missed)
+        self._samples: deque = deque(maxlen=max(int(max_samples), 16))
+
+    def record(
+        self,
+        state: str,
+        latency_s: float,
+        queue_wait_s: Optional[float] = None,
+        priority: int = 0,
+        deadline_missed: bool = False,
+        now: Optional[float] = None,
+    ) -> None:
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._samples.append((
+                t, int(priority), str(state), float(latency_s),
+                None if queue_wait_s is None else float(queue_wait_s),
+                bool(deadline_missed),
+            ))
+
+    def _window(self, now: Optional[float]) -> list:
+        t = self._clock() if now is None else now
+        cutoff = t - self.window_s
+        with self._lock:
+            # prune from the left (samples are time-ordered), then copy
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+            return list(self._samples)
+
+    @staticmethod
+    def _digest(samples: list) -> Dict[str, Any]:
+        lats = sorted(s[3] for s in samples)
+        waits = sorted(s[4] for s in samples if s[4] is not None)
+        denom = [s for s in samples if s[2] in SloTracker._MISS_DENOM_STATES]
+        missed = sum(1 for s in denom if s[5])
+        return {
+            "count": len(samples),
+            "miss_rate": (missed / len(denom)) if denom else 0.0,
+            "deadline_missed": missed,
+            "latency_s": {
+                "p50": round(_quantile(lats, 0.50), 4),
+                "p95": round(_quantile(lats, 0.95), 4),
+                "p99": round(_quantile(lats, 0.99), 4),
+            },
+            "queue_wait_s": {
+                "p50": round(_quantile(waits, 0.50), 4),
+                "p95": round(_quantile(waits, 0.95), 4),
+                "p99": round(_quantile(waits, 0.99), 4),
+            },
+        }
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        samples = self._window(now)
+        tiers: Dict[str, list] = {}
+        for s in samples:
+            tiers.setdefault(str(s[1]), []).append(s)
+        return {
+            "window_s": self.window_s,
+            "overall": self._digest(samples),
+            "tiers": {k: self._digest(v) for k, v in sorted(tiers.items())},
+        }
+
+    def miss_rate(self, now: Optional[float] = None) -> float:
+        return self._digest(self._window(now))["miss_rate"]
+
+
 class SpanToken:
     """Handle for a begin/end span (non-lexical intervals: io/ readers)."""
 
@@ -286,6 +397,10 @@ class Telemetry:
         )
         self._closed = False
         self._watch: Optional["RecompileWatch"] = None
+        # serve mode swaps the batch-progress heartbeat line for its own
+        # (queue depth, inflight, miss rate): a callable returning the
+        # line, or None/raising to fall back to heartbeat_line()
+        self.heartbeat_provider: Optional[Any] = None
         if self.enabled and output_root:
             tdir = os.path.join(output_root, "_telemetry")
             os.makedirs(tdir, exist_ok=True)
@@ -467,7 +582,14 @@ class Telemetry:
         if self._next_heartbeat is None or time.monotonic() < self._next_heartbeat:
             return
         self._next_heartbeat = time.monotonic() + self.heartbeat_s
-        print(self.heartbeat_line(), file=sys.stderr, flush=True)
+        line: Optional[str] = None
+        if self.heartbeat_provider is not None:
+            try:
+                line = self.heartbeat_provider()
+            except Exception:  # noqa: BLE001 - a broken provider must not kill the drain thread
+                line = None
+        print(line if line is not None else self.heartbeat_line(),
+              file=sys.stderr, flush=True)
 
     def heartbeat_line(self) -> str:
         done = int(self.metrics.counter("videos_done"))
@@ -743,6 +865,75 @@ def overlap_report(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "overlap_of_device": (overlap / dev_busy) if dev_busy > 0 else 0.0,
         "spans": sum(len(h) + len(d) for h, d in by_pid.values()),
     }
+
+
+def request_trace_rows(
+    rows: Sequence[Dict[str, Any]], request_id: str
+) -> List[Dict[str, Any]]:
+    """Assemble the spans belonging to ONE serve request out of a run's
+    combined span rows (``python -m video_features_tpu.telemetry trace
+    <request_id>``).
+
+    A serve request's spans live in two files: the daemon's telemetry
+    records the lifecycle (``admission``/``request``/``queue_wait``
+    spans carrying ``request=<id>``), while the resident extractor's
+    telemetry records the group dispatch (a ``request`` span whose
+    ``requests`` list links the member ids) and the per-video pipeline
+    stages. Selection:
+
+    1. anchors — every span whose ``request`` equals the id, plus every
+       group span whose ``requests`` list contains it;
+    2. descendants of an anchor via ``parent`` links (the dispatcher
+       thread's dispatch/fetch/sink spans nest under the group span);
+    3. same-pid spans for the request's video overlapping a group
+       span's interval (decode/prepare run on worker threads whose
+       spans do not parent-link into the group).
+
+    Result is t0-ordered; empty when the id appears nowhere."""
+    anchors: List[Dict[str, Any]] = []
+    for r in rows:
+        if r.get("request") == request_id:
+            anchors.append(r)
+        else:
+            reqs = r.get("requests")
+            if isinstance(reqs, (list, tuple)) and request_id in reqs:
+                anchors.append(r)
+    if not anchors:
+        return []
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    for r in rows:
+        p = r.get("parent")
+        if p:
+            children.setdefault(p, []).append(r)
+    selected: Dict[str, Dict[str, Any]] = {}
+    stack = list(anchors)
+    while stack:
+        r = stack.pop()
+        sid = r.get("span")
+        if not sid or sid in selected:
+            continue
+        selected[sid] = r
+        stack.extend(children.get(sid, ()))
+    videos = {r.get("video") for r in anchors if r.get("video")}
+    windows = [
+        (int(r.get("pid", 0)), float(r["t0"]), float(r["t1"]))
+        for r in anchors
+        if isinstance(r.get("requests"), (list, tuple))
+        and r.get("t0") is not None and r.get("t1") is not None
+    ]
+    if videos and windows:
+        for r in rows:
+            sid = r.get("span")
+            if not sid or sid in selected or r.get("video") not in videos:
+                continue
+            t0, t1 = r.get("t0"), r.get("t1")
+            if t0 is None or t1 is None:
+                continue
+            pid = int(r.get("pid", 0))
+            if any(pid == wp and float(t1) >= w0 and float(t0) <= w1
+                   for wp, w0, w1 in windows):
+                selected[sid] = r
+    return sorted(selected.values(), key=lambda r: (r.get("t0") or 0.0, r.get("seq", 0)))
 
 
 def spans_to_chrome_trace(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:

@@ -216,6 +216,9 @@ class AdmissionController:
 
     def _run_group(self, group: Group) -> None:
         key, reqs = group
+        if self._metrics is not None:
+            self._metrics.set_gauge("groups_inflight", 1)
+            self._metrics.inc("groups_dispatched")
         try:
             self._dispatch(key, reqs)
         except Exception:  # noqa: BLE001 - one bad group must not kill serving
@@ -227,6 +230,8 @@ class AdmissionController:
                   f"as 'failed' only if the dispatcher recorded them):")
             traceback.print_exc()
         finally:
+            if self._metrics is not None:
+                self._metrics.set_gauge("groups_inflight", 0)
             with self._cond:
                 self._depth -= len(reqs)
                 self._gauge_locked()
@@ -263,3 +268,25 @@ class AdmissionController:
     def _gauge_locked(self) -> None:
         if self._metrics is not None:
             self._metrics.set_gauge("queue_depth.admission", self._depth)
+            self._metrics.set_gauge(
+                "queue_age_oldest_s", self._oldest_wait_locked(self._clock())
+            )
+
+    def _oldest_wait_locked(self, now: float) -> float:
+        """Age of the oldest still-queued request (coalescing buffers +
+        ready groups), 0.0 when the queue is empty — the head-of-line
+        staleness signal for /metrics and the heartbeat."""
+        oldest: Optional[float] = None
+        for buf in self._buffers.values():
+            if buf and buf[0].admitted_at is not None:
+                t = buf[0].admitted_at
+                oldest = t if oldest is None else min(oldest, t)
+        for _, reqs in self._ready:
+            if reqs and reqs[0].admitted_at is not None:
+                t = reqs[0].admitted_at
+                oldest = t if oldest is None else min(oldest, t)
+        return max(now - oldest, 0.0) if oldest is not None else 0.0
+
+    def oldest_wait_s(self) -> float:
+        with self._cond:
+            return self._oldest_wait_locked(self._clock())

@@ -27,6 +27,7 @@ under ``--preprocess device``) land in the daemon's manifest log.
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
 import traceback
@@ -41,8 +42,9 @@ from video_features_tpu.extract.registry import build_extractor
 from video_features_tpu.io.sink import expected_output_files
 from video_features_tpu.runtime import faults
 from video_features_tpu.runtime import telemetry as telemetry_mod
-from video_features_tpu.runtime.telemetry import Telemetry
+from video_features_tpu.runtime.telemetry import SloTracker, Telemetry
 from video_features_tpu.serve.batcher import AdmissionController, Key, QueueFull
+from video_features_tpu.serve.costmodel import ServiceTimeModel, default_model_path
 from video_features_tpu.serve.lifecycle import (
     TERMINAL_STATES,
     BadRequest,
@@ -57,6 +59,12 @@ from video_features_tpu.serve.supervisor import (
     GroupTimeout,
     ModelUnavailable,
     Watchdog,
+)
+from video_features_tpu.telemetry.exposition import (
+    Family,
+    families_from_snapshot,
+    group_service_metric,
+    render_families,
 )
 
 
@@ -235,7 +243,19 @@ class ServeDaemon:
             enabled=self.cfg.telemetry != "off",
             heartbeat_s=float(self.cfg.heartbeat_s or 0.0),
         )
-        self.tracker = RequestTracker(self.cfg.output_path, telemetry=self.telemetry)
+        # the serve heartbeat replaces the batch-oriented default line
+        # (videos/s, ETA) with queue depth / inflight / miss rate
+        self.telemetry.heartbeat_provider = self._heartbeat_line
+        self._start_mono = clock()
+        self._hb_prev: Tuple[float, int] = (clock(), 0)
+        # rolling SLO window + the online service-time estimator; both
+        # live on the daemon's (injectable) scheduling clock
+        self.slo = SloTracker(window_s=scfg.slo_window_s, clock=clock)
+        self.cost_model = ServiceTimeModel(path=default_model_path(self.cfg))
+        self.tracker = RequestTracker(
+            self.cfg.output_path, telemetry=self.telemetry,
+            slo=self.slo, clock=clock,
+        )
         # crash recovery BEFORE any source can admit: requests a dead
         # process left queued/dispatched reach a durable state (spool
         # files re-queued, HTTP requests failed 'interrupted')
@@ -262,6 +282,7 @@ class ServeDaemon:
                 scfg.scheduler,
                 default_slack_s=scfg.default_slack_ms / 1000.0,
                 aging_s=scfg.aging_ms / 1000.0,
+                cost_model=self.cost_model,
             ),
         )
         self.watchdog = Watchdog(scfg.group_timeout_s)
@@ -298,35 +319,44 @@ class ServeDaemon:
         HTTP -> 503 with Retry-After and a ``rejected`` record, spool ->
         defer the file untouched)."""
         req = parse_request(payload, source)
-        if req.feature_type not in self.scfg.feature_types:
-            raise BadRequest(
-                f"feature_type {req.feature_type!r} not served (serving: "
-                f"{', '.join(self.scfg.feature_types)})"
-            )
-        if not os.path.exists(req.video_path):
-            raise BadRequest(f"video_path does not exist: {req.video_path}")
-        self._preflight(req)
-        faults.fire("admission")
-        breaker = self._breaker(req.feature_type)
-        if not breaker.allow_request():
-            exc = ModelUnavailable(req.feature_type, breaker.retry_after_s())
-            if req.source != "spool":
-                # terminal record for HTTP/local callers; the spool file
-                # is its own durable record and just waits out the open
-                self.tracker.reject(req, str(exc))
-            raise exc
-        rec = self.tracker.admit(req)
-        try:
-            self.batcher.admit(req)
-        except QueueFull:
-            if req.source == "spool":
-                # the spool file survives and re-submits under the same
-                # id next poll: back the admit out, no terminal record
-                self.tracker.forget(req)
-            else:
-                self.tracker.reject(req, f"queue full ({self.scfg.max_queue})")
-            raise
-        return rec
+        # the admission span covers validation + preflight probe +
+        # breaker gate + queue admit; tracker.admit's request span opens
+        # inside it, so the per-request trace starts at admission
+        with self.telemetry.span(
+            "admission", video=req.video_path, request=req.id,
+            feature_type=req.feature_type, bucket=req.bucket, source=source,
+        ):
+            if req.feature_type not in self.scfg.feature_types:
+                raise BadRequest(
+                    f"feature_type {req.feature_type!r} not served (serving: "
+                    f"{', '.join(self.scfg.feature_types)})"
+                )
+            if not os.path.exists(req.video_path):
+                raise BadRequest(f"video_path does not exist: {req.video_path}")
+            self._preflight(req)
+            faults.fire("admission")
+            breaker = self._breaker(req.feature_type)
+            if not breaker.allow_request():
+                exc = ModelUnavailable(req.feature_type, breaker.retry_after_s())
+                if req.source != "spool":
+                    # terminal record for HTTP/local callers; the spool
+                    # file is its own durable record and just waits out
+                    # the open
+                    self.tracker.reject(req, str(exc))
+                raise exc
+            rec = self.tracker.admit(req)
+            try:
+                self.batcher.admit(req)
+            except QueueFull:
+                if req.source == "spool":
+                    # the spool file survives and re-submits under the
+                    # same id next poll: back the admit out, no terminal
+                    # record
+                    self.tracker.forget(req)
+                else:
+                    self.tracker.reject(req, f"queue full ({self.scfg.max_queue})")
+                raise
+            return rec
 
     def _preflight(self, req: ExtractionRequest) -> None:
         """Admission-time media vouching (``--preflight on``). Runs
@@ -408,6 +438,7 @@ class ServeDaemon:
                 ):
                     ext.run_paths([r.video_path for r in live])
 
+            t_run = self.clock()
             try:
                 self.watchdog.run(body)
             except Exception as exc:  # noqa: BLE001 - loop-level crash: fail the group
@@ -438,6 +469,17 @@ class ServeDaemon:
                     self.pool.evict(feature_type)
                 return
             breaker.record_success()
+            # feed the online service-time estimator and the per-
+            # (feature_type, bucket) /metrics histogram from the group
+            # that just completed: the cost model only ever learns from
+            # successful dispatches (crashes/timeouts are supervision
+            # events, not service-time samples)
+            group_s = max(self.clock() - t_run, 0.0)
+            self.cost_model.observe(feature_type, key[1], len(live), group_s)
+            if self.telemetry.enabled:
+                self.telemetry.metrics.observe(
+                    group_service_metric(feature_type, key[1]), group_s
+                )
             outcomes = ext.manifest.take()
             for r in live:
                 got = outcomes.get(r.video_path)
@@ -673,6 +715,112 @@ class ServeDaemon:
             "watchdog_timeouts": self.watchdog.timeouts(),
         }
 
+    def stats(self) -> Dict[str, Any]:
+        """The /v1/stats body: /healthz plus the SLO window digest, the
+        cost model's learned per-item service times, and the raw metrics
+        snapshot — the JSON twin of /metrics."""
+        out = self.status()
+        out["uptime_s"] = round(max(self.clock() - self._start_mono, 0.0), 3)
+        out["slo"] = self.slo.snapshot()
+        out["cost_model"] = self.cost_model.snapshot()
+        out["metrics"] = self.telemetry.metrics.snapshot()
+        return out
+
+    def metrics_text(self) -> str:
+        """The /metrics body: Prometheus text exposition (format 0.0.4)
+        of the registry snapshot (request counters, queue gauges, stage
+        and group service-time histograms) plus the serve-native
+        families rendered directly from live daemon state (breakers,
+        SLO quantiles, uptime, watchdog)."""
+        fams = families_from_snapshot(self.telemetry.metrics.snapshot())
+        fams.extend(self._serve_families())
+        return render_families(fams)
+
+    _BREAKER_STATE_CODE = {"closed": 0, "half-open": 1, "half_open": 1, "open": 2}
+
+    def _serve_families(self) -> List[Family]:
+        """Exposition families computed from live state rather than the
+        metrics registry: circuit breakers, the rolling SLO window, and
+        daemon uptime."""
+        with self._lock:
+            breakers = {ft: b.snapshot() for ft, b in sorted(self._breakers.items())}
+        f_state = Family(
+            "vft_breaker_state", "gauge",
+            "Circuit breaker state per feature type (0=closed 1=half-open 2=open).",
+        )
+        f_opens = Family(
+            "vft_breaker_opens_total", "counter",
+            "Times each feature type's circuit breaker has opened.",
+        )
+        for ft, b in breakers.items():
+            labels = {"feature_type": ft}
+            f_state.add(labels, self._BREAKER_STATE_CODE.get(b["state"], 2))
+            f_opens.add(labels, b.get("opens", 0))
+        f_lat = Family(
+            "vft_slo_latency_seconds", "gauge",
+            "Rolling-window end-to-end request latency quantiles per priority tier.",
+        )
+        f_wait = Family(
+            "vft_slo_queue_wait_seconds", "gauge",
+            "Rolling-window queue-wait quantiles per priority tier.",
+        )
+        f_miss = Family(
+            "vft_slo_deadline_miss_ratio", "gauge",
+            "Rolling-window deadline-miss rate per priority tier "
+            "(denominator: done/failed/expired requests).",
+        )
+        f_n = Family(
+            "vft_slo_window_requests", "gauge",
+            "Terminal requests inside the rolling SLO window per priority tier.",
+        )
+        slo = self.slo.snapshot()
+        digests = {"overall": slo["overall"], **slo["tiers"]}
+        quantiles = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+        for tier, d in sorted(digests.items()):
+            for q, qlabel in quantiles.items():
+                ql = {"tier": tier, "quantile": qlabel}
+                f_lat.add(ql, d["latency_s"][q])
+                f_wait.add(ql, d["queue_wait_s"][q])
+            f_miss.add({"tier": tier}, d["miss_rate"])
+            f_n.add({"tier": tier}, d["count"])
+        f_up = Family("vft_uptime_seconds", "gauge",
+                      "Seconds since the serve daemon constructed.")
+        f_up.add(None, max(self.clock() - self._start_mono, 0.0))
+        f_wd = Family("vft_watchdog_timeouts_total", "counter",
+                      "Dispatch groups abandoned by the group watchdog.")
+        f_wd.add(None, self.watchdog.timeouts())
+        return [f_state, f_opens, f_lat, f_wait, f_miss, f_n, f_up, f_wd]
+
+    def _heartbeat_line(self) -> str:
+        """The serve heartbeat (replaces the batch videos/s line): queue
+        depth + oldest wait, inflight groups, completion rate since the
+        last beat, rolling deadline-miss rate, and any non-closed
+        breakers. Runs on the telemetry drain thread."""
+        now = self.clock()
+        snap = self.telemetry.metrics.snapshot()
+        completed = int(sum(
+            snap["counters"].get(f"requests_{s}", 0)
+            for s in ("done", "failed", "expired", "cancelled", "rejected")
+        ))
+        prev_t, prev_n = self._hb_prev
+        self._hb_prev = (now, completed)
+        rate = (completed - prev_n) / max(now - prev_t, 1e-9)
+        inflight = int(snap["gauges"].get("groups_inflight", 0))
+        with self._lock:
+            open_breakers = sorted(
+                ft for ft, b in self._breakers.items()
+                if b.snapshot()["state"] != "closed"
+            )
+        line = (
+            f"serve: queue={self.batcher.depth()} "
+            f"oldest_wait={self.batcher.oldest_wait_s():.1f}s "
+            f"inflight={inflight} completed/s={rate:.2f} "
+            f"miss_rate={self.slo.miss_rate():.1%}"
+        )
+        if open_breakers:
+            line += " breakers_open=" + ",".join(open_breakers)
+        return line
+
     def shutdown(self, drain: bool = True) -> None:
         """Stop sources, drain (default) or durably disposition the
         backlog, close telemetry, and write the final summary.json.
@@ -703,6 +851,12 @@ class ServeDaemon:
                     message="daemon shutdown before dispatch; resubmit to retry",
                 )
         self.pool.close()
+        try:
+            # persist the learned service times next to the compile
+            # cache so the next daemon's edf-cost scheduler starts warm
+            self.cost_model.save()
+        except OSError:
+            pass
         self.telemetry.close()
         try:
             # two summaries: per-video extraction records (the pooled
@@ -738,9 +892,40 @@ def serve_main(argv: Optional[Sequence[str]] = None) -> None:
             raise SystemExit(f"serve warmup: {len(failed)}/{len(results)} pair(s) failed")
         return
     daemon.start()
+    run_until_signalled(daemon)
+
+
+def run_until_signalled(daemon: ServeDaemon) -> None:
+    """Serve until SIGTERM / SIGINT, then drain and shut down.
+
+    SIGTERM used to kill the process mid-flight: only KeyboardInterrupt
+    reached the old ``finally``, so ``kill <pid>`` (every process
+    supervisor's stop signal) lost the final telemetry flush, the
+    request summary, and the cost-model save. Both signals now funnel
+    into one Event and :meth:`ServeDaemon.shutdown` runs in a
+    ``finally``. Handler installation is guarded so tests can call this
+    off the main thread (where ``signal.signal`` raises ValueError) and
+    deliver the signal themselves."""
+    stop = threading.Event()
+
+    def _handler(signum: int, frame: Any) -> None:
+        print(f"serve: received signal {signum}; draining and shutting down")
+        stop.set()
+
+    installed: List[Tuple[int, Any]] = []
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            installed.append((sig, signal.signal(sig, _handler)))
+        except ValueError:
+            pass
     try:
-        threading.Event().wait()  # serve until interrupted
+        stop.wait()
     except KeyboardInterrupt:
-        print("serve: draining and shutting down")
+        print("serve: interrupted; draining and shutting down")
     finally:
+        for sig, prev in installed:
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass
         daemon.shutdown()

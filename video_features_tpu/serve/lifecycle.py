@@ -170,14 +170,27 @@ class RequestTracker:
     handler threads — one lock covers the in-memory map; the manifest
     has its own (runtime/faults.py)."""
 
-    def __init__(self, output_root: str, telemetry: Any = None) -> None:
+    def __init__(
+        self,
+        output_root: str,
+        telemetry: Any = None,
+        slo: Any = None,
+        clock: Any = time.monotonic,
+    ) -> None:
         self.output_root = output_root
         self.results_dir = requests_root(output_root)
         self.manifest = RunManifest(self.results_dir)
         self.telemetry = telemetry
+        # the daemon's SloTracker (runtime/telemetry.py) and its
+        # scheduling clock: latency/queue-wait samples are measured on
+        # the same (injectable) clock the batcher stamps admitted_at/
+        # deadline_at with, so fake-clock tests and EDF ranks agree
+        self.slo = slo
+        self._clock = clock
         self._lock = threading.Lock()
         self._records: Dict[str, Dict[str, Any]] = {}
         self._spans: Dict[str, Any] = {}  # request id -> open telemetry token
+        self._qspans: Dict[str, Any] = {}  # request id -> open queue_wait token
 
     # -- transitions ----------------------------------------------------
 
@@ -206,8 +219,20 @@ class RequestTracker:
                 feature_type=req.feature_type, bucket=req.bucket,
             )
             if token is not None:
+                # the queue_wait child measures admission -> group
+                # dispatch (closed in dispatched(), or at the terminal
+                # transition for requests that never dispatch); explicit
+                # parent= pins it under the request span regardless of
+                # what is on the opener thread's span stack
+                qtoken = self.telemetry.begin(
+                    "queue_wait", video=req.video_path, request=req.id,
+                    feature_type=req.feature_type, bucket=req.bucket,
+                    parent=token.span_id,
+                )
                 with self._lock:
                     self._spans[req.id] = token
+                    if qtoken is not None:
+                        self._qspans[req.id] = qtoken
         # the queued record carries the full resubmittable payload: it
         # is what reconcile() rebuilds a request from after a crash
         extra: Dict[str, Any] = {}
@@ -223,11 +248,19 @@ class RequestTracker:
         return dict(rec)
 
     def dispatched(self, req: ExtractionRequest, group_size: int) -> None:
+        queue_wait = None
+        if req.admitted_at is not None:
+            queue_wait = max(self._clock() - req.admitted_at, 0.0)
         with self._lock:
             rec = self._records.get(req.id)
             if rec is not None:
                 rec["state"] = "dispatched"
                 rec["group_size"] = int(group_size)
+                if queue_wait is not None:
+                    rec["queue_wait_s"] = round(queue_wait, 4)
+            qtoken = self._qspans.pop(req.id, None)
+        if qtoken is not None:
+            qtoken.finish(group_size=int(group_size))
         self.manifest.record(
             f"request:{req.id}", "dispatched", group_size=int(group_size)
         )
@@ -242,9 +275,20 @@ class RequestTracker:
         features: Optional[List[str]] = None,
     ) -> Dict[str, Any]:
         """Terminal transition (done/failed/rejected): update the map,
-        append the manifest record, write the durable result JSON, and
-        close the request telemetry span."""
+        append the manifest record, write the durable result JSON,
+        close the request telemetry span, and fold the SLO sample
+        (latency, queue wait, deadline miss) into the daemon's
+        rolling-window tracker."""
         assert status in TERMINAL_STATES, status
+        now_mono = self._clock()
+        # a deadline is missed when the request was supposed to finish
+        # (ran or expired) and its budget had passed by the terminal
+        # transition; cancellations/rejections are not missed promises
+        missed = status == "expired" or (
+            status in ("done", "failed")
+            and req.deadline_at is not None
+            and now_mono > req.deadline_at
+        )
         with self._lock:
             rec = self._records.get(req.id)
             if rec is None:
@@ -254,6 +298,8 @@ class RequestTracker:
             rec["state"] = status
             rec["finished_ts"] = round(time.time(), 4)
             rec["wall_s"] = round(rec["finished_ts"] - rec.get("received_ts", rec["finished_ts"]), 4)
+            if missed:
+                rec["deadline_missed"] = True
             if error_class is not None:
                 rec["error_class"] = error_class
             if error_type is not None:
@@ -264,9 +310,28 @@ class RequestTracker:
                 rec["features"] = list(features)
             out = dict(rec)
             token = self._spans.pop(req.id, None)
+            qtoken = self._qspans.pop(req.id, None)
+        if qtoken is not None:
+            # never dispatched (expired/cancelled/rejected while queued):
+            # the queue_wait interval ends at the terminal transition
+            qtoken.finish(state=status)
         if token is not None:
             token.finish(state=status)
         self._count(f"requests_{status}")
+        if missed:
+            self._count("deadline_missed")
+        if self.slo is not None:
+            latency = (
+                now_mono - req.admitted_at if req.admitted_at is not None
+                else out["wall_s"]
+            )
+            self.slo.record(
+                status,
+                latency_s=max(float(latency), 0.0),
+                queue_wait_s=out.get("queue_wait_s"),
+                priority=int(req.priority or 0),
+                deadline_missed=missed,
+            )
         extra = {
             k: out[k]
             for k in ("error_class", "error_type", "message", "wall_s")
@@ -295,6 +360,9 @@ class RequestTracker:
         with self._lock:
             self._records.pop(req.id, None)
             token = self._spans.pop(req.id, None)
+            qtoken = self._qspans.pop(req.id, None)
+        if qtoken is not None:
+            qtoken.finish(state="deferred")
         if token is not None:
             token.finish(state="deferred")
         self._count("requests_deferred")
@@ -336,6 +404,9 @@ class RequestTracker:
         with self._lock:
             self._records.pop(req.id, None)
             token = self._spans.pop(req.id, None)
+            qtoken = self._qspans.pop(req.id, None)
+        if qtoken is not None:
+            qtoken.finish(state="requeued")
         if token is not None:
             token.finish(state="requeued")
         self._count("requests_requeued")

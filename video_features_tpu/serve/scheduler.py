@@ -29,7 +29,7 @@ part drive the same code with synthetic groups — no threads, no sleeps.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 # a group, as the batcher stores it: ((feature_type, bucket), [requests]).
 # Duplicated shape (not imported from batcher) to keep this module
@@ -42,7 +42,7 @@ Group = Tuple[Tuple[str, str], List[Any]]
 # deterministically instead of overflowing
 MAX_AGING_BOOST = 16
 
-SCHEDULER_NAMES = ("edf", "fifo")
+SCHEDULER_NAMES = ("edf", "fifo", "edf-cost")
 
 
 class EdfScheduler:
@@ -122,11 +122,93 @@ class FifoScheduler(EdfScheduler):
         return (0.0, 0.0)  # callers' index tie-break IS the order
 
 
+class CostAwareEdfScheduler(EdfScheduler):
+    """EDF with a calibrated service-time model (``--scheduler
+    edf-cost``): rank by *latest feasible start time* and demote groups
+    that cannot meet their deadline anyway.
+
+    Plain EDF's overload pathology on a serial non-preemptive machine:
+    the earliest deadline may belong to a group so expensive it is
+    already doomed — running it first burns its whole service time AND
+    dominoes every cheap group behind it past their own deadlines. Note
+    that pure least-laxity (``deadline - predicted``) makes this
+    *worse*: a doomed expensive group has the most negative laxity, so
+    it ranks MORE urgent, and total work is conserved — reordering only
+    renames which requests miss. The win comes from feasibility:
+
+    - a group is **doomed** when ``now + predicted_service`` already
+      exceeds its earliest *declared* member deadline (slack-derived
+      effective deadlines never doom a group — missing them is a
+      soft ordering preference, not a contract);
+    - feasible groups rank by (aged priority tier desc, latest start
+      time ``effective_deadline - predicted_service`` asc) — the group
+      that must start soonest to still make it goes first, which is
+      exactly EDF when predictions are equal (and exactly EDF with 0.0
+      predictions, i.e. a cold :class:`~video_features_tpu.serve.
+      costmodel.ServiceTimeModel`);
+    - doomed groups sort behind every feasible group (still mutually
+      ordered by tier + latest-start), so their members expire at the
+      dispatch boundary or run late — after the work that can still
+      meet its promises.
+
+    The model's ``predict`` is consulted under the batcher's condition
+    variable; it takes only the model's own lock and does no I/O
+    (GC311: the nesting batcher-cond -> model-lock is acyclic — nothing
+    in costmodel calls back into the batcher)."""
+
+    name = "edf-cost"
+
+    def __init__(
+        self,
+        cost_model: Any,
+        default_slack_s: float = 30.0,
+        aging_s: float = 10.0,
+    ) -> None:
+        super().__init__(default_slack_s=default_slack_s, aging_s=aging_s)
+        self.cost_model = cost_model
+
+    def predicted_service_s(self, group: Group, now: float) -> float:
+        key, requests = group
+        try:
+            return max(float(self.cost_model.predict(key, len(requests)) or 0.0), 0.0)
+        except Exception:  # noqa: BLE001 - a broken model must not stop dispatch
+            return 0.0
+
+    @staticmethod
+    def _earliest_declared_deadline(requests: Sequence[Any]) -> Optional[float]:
+        best: Optional[float] = None
+        for r in requests:
+            d = getattr(r, "deadline_at", None)
+            if d is not None and (best is None or d < best):
+                best = d
+        return best
+
+    def rank(self, group: Group, now: float) -> Tuple[float, float, float]:
+        neg_tier, eff_deadline = super().rank(group, now)
+        pred = self.predicted_service_s(group, now)
+        declared = self._earliest_declared_deadline(group[1])
+        doomed = 1.0 if (
+            pred > 0.0 and declared is not None and now + pred > declared
+        ) else 0.0
+        return (doomed, neg_tier, eff_deadline - pred)
+
+
 def build_scheduler(
-    name: str, default_slack_s: float = 30.0, aging_s: float = 10.0
+    name: str,
+    default_slack_s: float = 30.0,
+    aging_s: float = 10.0,
+    cost_model: Any = None,
 ) -> EdfScheduler:
     if name not in SCHEDULER_NAMES:
         raise ValueError(f"unknown scheduler {name!r} (expected one of {SCHEDULER_NAMES})")
+    if name == "edf-cost":
+        if cost_model is None:
+            from video_features_tpu.serve.costmodel import ServiceTimeModel
+
+            cost_model = ServiceTimeModel()
+        return CostAwareEdfScheduler(
+            cost_model, default_slack_s=default_slack_s, aging_s=aging_s
+        )
     cls = FifoScheduler if name == "fifo" else EdfScheduler
     return cls(default_slack_s=default_slack_s, aging_s=aging_s)
 
@@ -134,23 +216,27 @@ def build_scheduler(
 def simulate_dispatch(
     groups: Sequence[Group],
     scheduler: EdfScheduler,
-    service_s: float,
+    service_s: Union[float, Callable[[Tuple[str, str], Sequence[Any]], float]],
     start: float = 0.0,
 ) -> List[Dict[str, Any]]:
     """Deterministic serial-dispatch simulation over ready groups: one
     group per ``service_s`` tick, ordered by ``scheduler.pick`` at each
-    tick (so aging acts over simulated time). Returns one record per
+    tick (so aging acts over simulated time). ``service_s`` may be a
+    constant or a ``(key, requests) -> seconds`` callable — the
+    heterogeneous-cost burst the edf-cost acceptance test and the
+    ``serve_cost_model`` bench part replay. Returns one record per
     request with its completion time, latency, and whether its deadline
-    was met — shared by the pinned EDF-beats-FIFO tier-1 test and the
-    ``serve_scheduling`` bench part, so the benched policy is exactly
-    the tested one."""
+    was met — shared by the pinned scheduler tier-1 tests and the bench
+    parts, so the benched policy is exactly the tested one."""
     pending: List[Group] = list(groups)
     now = float(start)
     out: List[Dict[str, Any]] = []
     while pending:
         i = scheduler.pick(pending, now)
         key, requests = pending.pop(i)
-        now += float(service_s)
+        now += float(
+            service_s(key, requests) if callable(service_s) else service_s
+        )
         for r in requests:
             deadline = getattr(r, "deadline_at", None)
             admitted = getattr(r, "admitted_at", None)

@@ -19,6 +19,12 @@ framework; anything fancier belongs behind a real proxy):
   rejected/expired — too late to cancel), 404 for unknown ids.
 - ``GET /healthz`` — queue depth, per-state counts, warm model list,
   scheduler name, per-model circuit-breaker state.
+- ``GET /metrics`` — Prometheus text exposition (format 0.0.4) of the
+  daemon's metrics registry plus live serve families (breaker state,
+  SLO quantiles, uptime); stdlib-rendered, no client library. See
+  docs/observability.md "Live serve metrics".
+- ``GET /v1/stats`` — the JSON twin of /metrics: /healthz plus the SLO
+  window digest, cost-model snapshot, and raw metrics snapshot.
 
 ThreadingHTTPServer: handlers run on per-connection threads, so
 everything they touch (daemon.submit -> tracker/batcher) is lock-guarded
@@ -51,6 +57,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         if retry_after > 0:
             self.send_header("Retry-After", str(max(int(retry_after), 1)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        data = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
 
@@ -130,6 +144,17 @@ class ServeHandler(BaseHTTPRequestHandler):
         path = self.path.rstrip("/")
         if path == "/healthz":
             self._send(200, daemon.status())
+            return
+        if path == "/metrics":
+            # the content type Prometheus scrapers negotiate for the
+            # 0.0.4 text format
+            self._send_text(
+                200, daemon.metrics_text(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        if path == "/v1/stats":
+            self._send(200, daemon.stats())
             return
         prefix = "/v1/requests/"
         if self.path.startswith(prefix):

@@ -20,11 +20,18 @@ from video_features_tpu.runtime.telemetry import (  # noqa: F401
     HOST_STAGES,
     STAGES,
     MetricsRegistry,
+    SloTracker,
     Telemetry,
     collect,
     overlap_report,
     read_spans,
+    request_trace_rows,
     spans_to_chrome_trace,
+)
+from video_features_tpu.telemetry.exposition import (  # noqa: F401
+    families_from_snapshot,
+    render_families,
+    validate_exposition,
 )
 
 SCHEMA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -9,6 +9,13 @@ Consumers for the span files a run leaves under ``<output>/_telemetry/``:
 - ``report PATH`` — the overlap-efficiency summary (same math that
   lands in ``summary.json``): host-busy vs device-busy vs overlapped
   wall time, per the span intervals.
+- ``trace REQUEST_ID PATHS... [-o trace.json]`` — the per-request
+  Perfetto trace for ONE serve request: the daemon's lifecycle spans
+  (admission / request / queue_wait), the group span linking the
+  member ids, and the group's pipeline stages (dispatch / fetch /
+  sink, plus the worker-thread decode/prepare spans for the request's
+  video) assembled across the daemon's and the resident extractor's
+  spans files. See docs/observability.md "Live serve metrics".
 
 Exit codes: 0 ok, 2 usage error / no spans found. No jax import — these
 run fine on a laptop against files rsynced off a TPU host.
@@ -26,6 +33,7 @@ from typing import List
 from video_features_tpu.runtime.telemetry import (
     overlap_report,
     read_spans,
+    request_trace_rows,
     spans_to_chrome_trace,
 )
 
@@ -58,6 +66,14 @@ def main(argv: List[str]) -> int:
     p_report.add_argument("paths", nargs="+",
                           help="spans-*.jsonl files, a _telemetry dir, or an output root")
     p_report.add_argument("--json", action="store_true", help="emit the raw report dict")
+    p_trace = sub.add_parser(
+        "trace", help="one serve request's spans -> Chrome-trace JSON"
+    )
+    p_trace.add_argument("request_id", help="the request id (lifecycle record id)")
+    p_trace.add_argument("paths", nargs="+",
+                         help="spans-*.jsonl files, a _telemetry dir, or an output root")
+    p_trace.add_argument("-o", "--output", default=None,
+                         help="trace JSON path (default: stdout)")
     args = parser.parse_args(argv)
 
     files = _resolve_span_files(args.paths)
@@ -72,7 +88,15 @@ def main(argv: List[str]) -> int:
         print("telemetry: no spans found", file=sys.stderr)
         return 2
 
-    if args.cmd == "export":
+    if args.cmd in ("export", "trace"):
+        if args.cmd == "trace":
+            rows = request_trace_rows(rows, args.request_id)
+            if not rows:
+                print(
+                    f"telemetry: no spans mention request {args.request_id!r}",
+                    file=sys.stderr,
+                )
+                return 2
         trace = spans_to_chrome_trace(rows)
         text = json.dumps(trace)
         if args.output:
