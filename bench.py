@@ -1377,6 +1377,60 @@ def _sub_metrics_endpoint_overhead() -> dict:
     return out
 
 
+def _sub_ledger_overhead() -> dict:
+    """Steady-state cost of the device cost ledger (ISSUE 15 <1%
+    ceiling): once an executable's (family, signature) pair is captured,
+    every further call through the instrument_state wrapper pays only a
+    lock + seen-set membership check. Measured on-minus-off over the
+    same pre-compiled jit call (off = the bare state dict), plus one
+    DeviceMemorySampler.sample_once() — the memory_stats poll is paid
+    per sampling interval, not per video, so it is reported separately
+    and added to the per-video figure as a worst case (one poll per
+    video)."""
+    import timeit
+
+    import jax
+
+    from video_features_tpu.runtime.telemetry import MetricsRegistry
+    from video_features_tpu.telemetry.ledger import (
+        CostLedger,
+        DeviceMemorySampler,
+        instrument_state,
+    )
+
+    n = 2000
+    params = {"w": np.ones((64, 64), np.float32)}
+    x = np.ones((8, 64), np.float32)
+    fwd = jax.jit(lambda p, v: v @ p["w"])
+    fwd(params, x).block_until_ready()  # compile outside the timing
+
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        ledger = CostLedger(os.path.join(tmp, "cost_ledger.json"))
+        wrapped = instrument_state(
+            {"params": params, "forward": fwd}, ledger, model="bench"
+        )
+        wrapped["forward"](params, x)  # one-time AOT capture, excluded
+        off_s = timeit.timeit(lambda: fwd(params, x), number=n)
+        on_s = timeit.timeit(lambda: wrapped["forward"](params, x), number=n)
+        sampler = DeviceMemorySampler(MetricsRegistry())
+        t0 = time.perf_counter()
+        for _ in range(50):
+            sampler.sample_once()
+        sample_us = (time.perf_counter() - t0) / 50 * 1e6
+        out["ledger_entries_recorded"] = len(ledger)
+    delta_us = max(on_s - off_s, 0.0) / n * 1e6
+    headline_s_per_video = 1.0 / 3.637  # BENCH_r01 chip headline
+    pct = (delta_us + sample_us) / 1e6 / headline_s_per_video * 100.0
+    out["ledger_wrapped_call_us"] = round(on_s / n * 1e6, 2)
+    out["ledger_bare_call_us"] = round(off_s / n * 1e6, 2)
+    out["ledger_overhead_us_per_video"] = round(delta_us, 2)
+    out["ledger_sampler_sample_us"] = round(sample_us, 2)
+    out["ledger_overhead_pct_vs_headline"] = round(pct, 4)
+    out["ledger_within_budget"] = pct < 1.0
+    return out
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -1399,6 +1453,7 @@ SUB_PARTS = {
     "serve_scheduling": _sub_serve_scheduling,
     "serve_cost_model": _sub_serve_cost_model,
     "metrics_endpoint_overhead": _sub_metrics_endpoint_overhead,
+    "ledger_overhead": _sub_ledger_overhead,
 }
 
 
@@ -1493,6 +1548,206 @@ def _probe_backend(timeout_s: float = 180.0, fatal: bool = True) -> bool:
     return True
 
 
+# -- regression sentinel (`bench.py --compare`) ---------------------------
+#
+# Pure stdlib (no jax, no numpy math): compares one BENCH artifact
+# against the committed trajectory (BENCH_r0*.json) with noise-aware
+# tolerances, so CI can fail a PR that regresses a measured number
+# without flapping on benchmark jitter. The trajectory is sparse —
+# tunnel-dead rounds carry rc!=0 and few or no parsed numbers — so every
+# key is judged only against the base files that actually measured it.
+
+# keys that are configuration echoes or environment facts, not
+# measurements — never compared
+_COMPARE_SKIP_SUBTREES = ("bench_config",)
+_COMPARE_SKIP_LEAVES = frozenset({
+    "host_cores", "baseline_provenance", "compile_cache",
+    "device_contracts", "fatal", "n", "rc",
+})
+
+
+def _flatten_bench(doc: dict) -> tuple:
+    """One BENCH artifact -> (numeric {key: float}, budget {key: bool}).
+    Accepts the committed shape ({n, cmd, rc, parsed: {value, extra}})
+    or a bare parsed dict. The headline `value` flattens to 'headline';
+    everything numeric under `extra` flattens dotted."""
+    parsed = doc.get("parsed", doc) or {}
+    nums, budgets = {}, {}
+    v = parsed.get("value")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        nums["headline"] = float(v)
+    vb = parsed.get("vs_baseline")
+    if isinstance(vb, (int, float)) and not isinstance(vb, bool):
+        nums["vs_baseline"] = float(vb)
+
+    def walk(prefix, obj):
+        if isinstance(obj, dict):
+            for k, val in sorted(obj.items()):
+                if k in _COMPARE_SKIP_SUBTREES or k in _COMPARE_SKIP_LEAVES:
+                    continue
+                walk(prefix + (k,), val)
+        elif isinstance(obj, bool):
+            if prefix and prefix[-1].endswith("_within_budget"):
+                budgets[".".join(prefix)] = obj
+        elif isinstance(obj, (int, float)):
+            nums[".".join(prefix)] = float(obj)
+
+    walk((), parsed.get("extra") or {})
+    return nums, budgets
+
+
+def _compare_direction(key: str):
+    """'higher' (throughput-like), 'lower' (latency/overhead-like), or
+    None (informational: counts, sizes, unknown units — never fails)."""
+    leaf = key.rsplit(".", 1)[-1]
+    if (leaf == "headline" or leaf == "vs_baseline"
+            or leaf.endswith(("_vps", "_fps", "_per_s"))
+            or "speedup" in leaf or "throughput" in leaf):
+        return "higher"
+    if (leaf.endswith(("_s", "_ms", "_us", "_pct"))
+            or "_s_per_" in leaf or "_us_per_" in leaf
+            or "overhead" in leaf or "latency" in leaf or "miss" in leaf):
+        return "lower"
+    return None
+
+
+def _compare_tolerance(samples: list) -> float:
+    """Relative tolerance around the base median. With >= 3 samples the
+    spread is measured (3 * MAD / median); fewer samples get a generous
+    floor — one sample says nothing about run-to-run noise."""
+    import statistics
+
+    med = statistics.median(samples)
+    floor = 0.25
+    if len(samples) >= 3 and med:
+        mad = statistics.median(abs(s - med) for s in samples)
+        return max(floor, 3.0 * mad / abs(med))
+    return floor
+
+
+def compare_bench(current: dict, bases: list) -> dict:
+    """Compare one parsed BENCH artifact against >= 1 base artifacts.
+    Returns {'keys': {key: {...}}, 'regressed': [...], 'improved': [...],
+    'base_keys': N}; see _compare_main for the rc contract."""
+    import statistics
+
+    cur_nums, cur_budgets = _flatten_bench(current)
+    base_flat = [_flatten_bench(b) for b in bases]
+    base_keys = sorted({k for nums, _ in base_flat for k in nums})
+    out = {"keys": {}, "regressed": [], "improved": [], "base_keys": len(base_keys)}
+
+    for key in base_keys:
+        samples = [nums[key] for nums, _ in base_flat if key in nums]
+        med = statistics.median(samples)
+        direction = _compare_direction(key)
+        rec = {
+            "direction": direction, "base_median": med,
+            "n_samples": len(samples),
+        }
+        if key not in cur_nums:
+            rec["status"] = "missing"  # informational: parts can be skipped
+        elif direction is None or med == 0:
+            rec.update(current=cur_nums[key], status="info")
+        else:
+            cur = cur_nums[key]
+            tol = _compare_tolerance(samples)
+            ratio = cur / med
+            rec.update(current=cur, tolerance=round(tol, 4),
+                       ratio=round(ratio, 4))
+            worse = ratio < 1.0 - tol if direction == "higher" else ratio > 1.0 + tol
+            better = ratio > 1.0 + tol if direction == "higher" else ratio < 1.0 - tol
+            rec["status"] = "regressed" if worse else ("improved" if better else "ok")
+            if worse:
+                out["regressed"].append(key)
+            elif better:
+                out["improved"].append(key)
+        out["keys"][key] = rec
+    for key in sorted(set(cur_nums) - set(base_keys)):
+        out["keys"][key] = {"status": "new", "current": cur_nums[key]}
+    # budget booleans are hard gates, not noise-banded measurements: a
+    # False *_within_budget in the current artifact is a regression even
+    # if no base ever measured that part
+    for key, ok in sorted(cur_budgets.items()):
+        rec = out["keys"].setdefault(key, {})
+        rec.update(current=ok, status="ok" if ok else "regressed")
+        if not ok:
+            out["regressed"].append(key)
+    return out
+
+
+def _compare_main(argv: list) -> int:
+    """``bench.py --compare BASE.json[,BASE2.json...] [BASE3.json ...]
+    [--current CUR.json] [-o summary.json]`` — rc 0 pass, 1 regression,
+    2 usage / no usable base numbers. --current defaults to the newest
+    BENCH_r*.json in the CWD that is not among the bases."""
+    import argparse
+    import glob as _glob
+
+    p = argparse.ArgumentParser(prog="bench.py --compare")
+    p.add_argument("bases", nargs="+",
+                   help="base BENCH artifacts (comma- or space-separated)")
+    p.add_argument("--current", default=None,
+                   help="artifact under test (default: newest BENCH_r*.json "
+                        "not among the bases)")
+    p.add_argument("-o", "--output", default=None,
+                   help="write the comparison summary JSON here (CI artifact)")
+    args = p.parse_args(argv)
+    base_paths = [b for arg in args.bases for b in arg.split(",") if b]
+    current_path = args.current
+    if current_path is None:
+        pool = sorted(
+            set(_glob.glob("BENCH_r*.json")) - {os.path.normpath(b) for b in base_paths}
+        )
+        if not pool:
+            print("compare: no --current and no candidate BENCH_r*.json",
+                  file=sys.stderr)
+            return 2
+        current_path = pool[-1]
+
+    def load(path):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"compare: cannot read {path}: {e}", file=sys.stderr)
+            return None
+
+    bases = [d for d in (load(b) for b in base_paths) if d is not None]
+    current = load(current_path)
+    if current is None or not bases:
+        return 2
+    result = compare_bench(current, bases)
+    result["current"] = current_path
+    result["bases"] = base_paths
+    if result["base_keys"] == 0:
+        print("compare: no numeric keys in any base artifact", file=sys.stderr)
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=2, sort_keys=True)
+        return 2
+    print(f"compare: {current_path} vs {len(bases)} base artifact(s), "
+          f"{result['base_keys']} base key(s)")
+    for key, rec in sorted(result["keys"].items()):
+        st = rec.get("status")
+        if st in ("regressed", "improved"):
+            print(f"  {st.upper():>9} {key}: {rec.get('current')} "
+                  f"(base median {rec.get('base_median')}, "
+                  f"tol ±{rec.get('tolerance', 0):.0%})"
+                  if "tolerance" in rec else
+                  f"  {st.upper():>9} {key}: {rec.get('current')}")
+    n_ok = sum(1 for r in result["keys"].values() if r.get("status") == "ok")
+    print(f"compare: {n_ok} ok, {len(result['improved'])} improved, "
+          f"{len(result['regressed'])} regressed")
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+    if result["regressed"]:
+        print("compare: REGRESSED: " + ", ".join(result["regressed"]),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> None:
     n_videos = int(os.environ.get("BENCH_VIDEOS", "16"))
     baselines = _load_measured_baselines()
@@ -1585,6 +1840,10 @@ def main() -> None:
     # percentiles on a pinned deterministic burst (pure host, no device)
     extra.update(_spawn_sub("serve_scheduling", 120.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
+    # device cost ledger steady-state cost (ISSUE 15 <1% ceiling: the
+    # instrument_state wrapper's seen-set check + one memory_stats poll)
+    extra.update(_spawn_sub("ledger_overhead", 300.0, env={"JAX_PLATFORMS": "cpu"}))
+    emit()
 
     if not _probe_backend(fatal=False):
         extra["fatal"] = (
@@ -1673,4 +1932,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) == 3 and sys.argv[1] == "--sub":
         sys.exit(_run_sub_part(sys.argv[2]))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--compare":
+        # pure-host sentinel: no backend probe, no jax import
+        sys.exit(_compare_main(sys.argv[2:]))
     sys.exit(main())

@@ -169,6 +169,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "telemetry_overhead": {"telemetry_overhead_us_per_video": 15.0},
         "serve_latency": {"serve_warm_request_s": 0.5},
         "serve_scheduling": {"serve_sched_edf_miss_rate": 0.0},
+        "ledger_overhead": {"ledger_overhead_us_per_video": 16.0},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -203,6 +204,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["telemetry_overhead_us_per_video"] == 15.0
     assert final["extra"]["serve_warm_request_s"] == 0.5
     assert final["extra"]["serve_sched_edf_miss_rate"] == 0.0
+    assert final["extra"]["ledger_overhead_us_per_video"] == 16.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -242,6 +244,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"serve_warm_request_s": 0.5}
         if name == "serve_scheduling":  # pure-host FIFO-vs-EDF simulation
             return {"serve_sched_edf_miss_rate": 0.0}
+        if name == "ledger_overhead":  # AOT analysis micro-bench, CPU-pinned
+            return {"ledger_overhead_us_per_video": 16.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

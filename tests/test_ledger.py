@@ -1,0 +1,499 @@
+"""Device cost ledger (ISSUE 15): per-executable HBM/flops accounting,
+live device-memory gauges, the per-device utilization timeline, and the
+bench regression sentinel.
+
+Layers under test, shallow to deep:
+
+- CostLedger persistence: atomic save / reload round-trip, torn-file and
+  version-mismatch tolerance (same contract costmodel.py pins);
+- the AOT capture path on a real CPU jit function (cost_analysis /
+  memory_analysis via instrument_state), including the RecompileWatch
+  suppression that keeps analysis compiles out of the GC401 budgets;
+- HBM projection semantics: CPU entries record honest byte sizes but
+  never count toward the resident-HBM projection, so ``vft_hbm_bytes``
+  is legitimately absent on CPU backends (absent, never zero-filled);
+- exposition mapping (families_from_ledger, the vft_device_mem_bytes
+  registry branch) + check_exposition negatives for the new families;
+- DeviceMemorySampler: absent gauges on backends without memory_stats
+  (CPU), real gauges + headroom from a fake device;
+- utilization_report / --device-lanes trace mirroring;
+- the ``telemetry ledger`` CLI rc contract (0 rendered, 2 missing);
+- ``bench.py --compare``: clean trajectory passes, injected synthetic
+  regression and tripped *_within_budget booleans exit nonzero;
+- serve wiring: ledger block in stats(), warmup HBM fail-fast against
+  --hbm_budget_bytes.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from video_features_tpu.runtime.telemetry import (
+    MetricsRegistry,
+    RecompileWatch,
+    Telemetry,
+    compile_watch_suppressed,
+    spans_to_chrome_trace,
+    suppress_compile_watch,
+    utilization_report,
+)
+from video_features_tpu.telemetry.exposition import (
+    check_exposition,
+    families_from_ledger,
+    families_from_snapshot,
+    render_families,
+    validate_exposition,
+)
+from video_features_tpu.telemetry.ledger import (
+    LEDGER_FILENAME,
+    CostLedger,
+    DeviceMemorySampler,
+    bucket_of,
+    format_bytes,
+    instrument_state,
+    load_ledger,
+)
+
+TPU_MEM = {
+    "argument_bytes": 1000,
+    "output_bytes": 100,
+    "temp_bytes": 50,
+    "generated_code_bytes": 10,
+}
+
+
+# --- persistence ------------------------------------------------------------
+
+
+def test_ledger_roundtrip_and_n_compiles(tmp_path):
+    path = str(tmp_path / LEDGER_FILENAME)
+    led = CostLedger(path)
+    led.record("resnet18", "forward", "4x8", "queue", "cpu",
+               {"flops": 512.0, "bytes_accessed": 512.0})
+    led.record("resnet18", "forward", "4x8", "queue", "cpu",
+               {"flops": 512.0, "bytes_accessed": 512.0})
+    assert len(led) == 1
+    assert led.entries()[0]["n_compiles"] == 2
+    assert os.path.isfile(path)  # every record persists (save_every=1)
+    led2 = CostLedger(path)
+    assert led2.entries() == led.entries()
+
+
+def test_ledger_tolerates_torn_and_mismatched_files(tmp_path):
+    torn = tmp_path / LEDGER_FILENAME
+    torn.write_text('{"version": 1, "entr')  # torn mid-write
+    led = CostLedger(str(torn))
+    assert len(led) == 0
+    led.record("m", "f", "4x8", "queue", "cpu", {"flops": 1.0})
+    assert len(CostLedger(str(torn))) == 1  # recovers by rewriting
+
+    wrong = tmp_path / "v999" / LEDGER_FILENAME
+    wrong.parent.mkdir()
+    wrong.write_text(json.dumps({"version": 999, "entries": [{"model": "x"}]}))
+    assert len(CostLedger(str(wrong))) == 0
+
+
+def test_load_ledger_is_none_when_missing(tmp_path):
+    assert load_ledger(str(tmp_path / "nope.json")) is None
+
+
+def test_shared_returns_one_ledger_per_path(tmp_path):
+    path = str(tmp_path / LEDGER_FILENAME)
+    assert CostLedger.shared(path) is CostLedger.shared(path)
+
+
+# --- AOT capture on a real CPU jit fn ---------------------------------------
+
+
+@pytest.fixture
+def captured(tmp_path):
+    import jax
+
+    led = CostLedger(str(tmp_path / LEDGER_FILENAME))
+    params = {"w": np.ones((8, 8), np.float32)}
+    state = {"params": params,
+             "forward": jax.jit(lambda p, x: x @ p["w"]),
+             "device": jax.devices()[0]}
+    wrapped = instrument_state(state, led, model="resnet18", sharding="queue")
+    y = wrapped["forward"](params, np.ones((4, 8), np.float32))
+    return led, wrapped, params, np.asarray(y)
+
+
+def test_instrument_state_records_flops_and_memory(captured):
+    led, wrapped, params, y = captured
+    assert y.shape == (4, 8)  # execution result untouched
+    (e,) = led.entries()
+    assert e["model"] == "resnet18"
+    assert e["family"] == "forward"
+    assert e["bucket"] == "4x8"  # largest data leaf, params arg skipped
+    assert e["platform"] == "cpu"
+    assert e["flops"] > 0
+    assert e["bytes_accessed"] > 0
+    assert e["memory"]["argument_bytes"] > 0
+    assert wrapped["forward"].__wrapped_for_ledger__
+
+
+def test_capture_is_once_per_signature(captured):
+    led, wrapped, params, _ = captured
+    wrapped["forward"](params, np.ones((4, 8), np.float32))  # same sig
+    assert len(led) == 1
+    wrapped["forward"](params, np.ones((2, 8), np.float32))  # new bucket
+    assert sorted(e["bucket"] for e in led.entries()) == ["2x8", "4x8"]
+
+
+def test_bucket_of_skips_params_and_handles_no_leaves():
+    params = {"w": np.ones((8, 8), np.float32)}
+    assert bucket_of((params, np.ones((2, 3, 4), np.float32))) == "2x3x4"
+    assert bucket_of((1, "x")) == "~"
+
+
+def test_suppress_compile_watch_is_thread_local_and_reentrant():
+    assert not compile_watch_suppressed()
+    with suppress_compile_watch():
+        assert compile_watch_suppressed()
+        with suppress_compile_watch():
+            assert compile_watch_suppressed()
+        assert compile_watch_suppressed()
+    assert not compile_watch_suppressed()
+
+
+def test_recompile_watch_ignores_suppressed_compiles():
+    w = RecompileWatch(Telemetry(enabled=False), manifest=None)
+    with suppress_compile_watch():
+        w.on_compile("fused_fn")
+    assert w.counts == {}
+    w.on_compile("fused_fn")
+    assert w.counts == {"fused_fn": 1}
+
+
+# --- HBM projection ---------------------------------------------------------
+
+
+def test_hbm_projection_skips_cpu_and_maxes_weights(tmp_path):
+    led = CostLedger(str(tmp_path / LEDGER_FILENAME))
+    led.record("resnet18", "forward", "4x8", "queue", "cpu",
+               {"flops": 1.0, "memory": dict(TPU_MEM)})
+    assert led.hbm_projection() == {}  # CPU bytes are honest but not HBM
+    assert led.projected_resident_bytes() == 0
+
+    led.record("i3d", "forward", "2x64", "queue", "tpu",
+               {"flops": 1.0, "memory": dict(TPU_MEM)})
+    big = {**TPU_MEM, "argument_bytes": 4000, "generated_code_bytes": 7}
+    led.record("i3d", "forward", "2x128", "queue", "tpu",
+               {"flops": 1.0, "memory": big})
+    proj = led.hbm_projection()
+    assert list(proj) == ["i3d"]
+    # weights are shared across bucket variants: arguments MAX, code SUMs
+    assert proj["i3d"]["arguments"] == 4000
+    assert proj["i3d"]["generated_code"] == 17
+    assert proj["i3d"]["resident"] == 4000 + 100 + 50 + 17
+    assert led.projected_resident_bytes(["i3d"]) == proj["i3d"]["resident"]
+    assert led.projected_resident_bytes(["resnet18"]) == 0
+
+
+# --- exposition mapping -----------------------------------------------------
+
+
+def test_families_from_ledger_renders_and_validates(tmp_path):
+    led = CostLedger(str(tmp_path / LEDGER_FILENAME))
+    led.record("resnet18", "forward", "4x8", "queue", "cpu",
+               {"flops": 512.0, "bytes_accessed": 512.0})
+    text = render_families(families_from_ledger(led.snapshot()))
+    assert check_exposition(text) == []
+    assert ('vft_executable_flops{bucket="4x8",family="forward",'
+            'model="resnet18",sharding="queue"} 512') in text
+    assert "vft_executable_bytes_accessed" in text
+    assert "vft_hbm_bytes" not in text  # absent, not zero, on CPU
+
+    led.record("resnet18", "forward", "4x8", "queue", "tpu",
+               {"flops": 512.0, "memory": dict(TPU_MEM)})
+    text = render_families(families_from_ledger(led.snapshot()))
+    assert check_exposition(text) == []
+    assert 'vft_hbm_bytes{kind="resident",model="resnet18"} 1160' in text
+    assert 'vft_hbm_bytes{kind="arguments",model="resnet18"} 1000' in text
+
+
+def test_families_from_ledger_empty_snapshot_has_no_families():
+    assert families_from_ledger({"entries": [], "hbm_projection": {}}) == []
+
+
+def test_device_mem_gauges_map_to_labelled_family():
+    reg = MetricsRegistry()
+    reg.set_gauge("device_mem_bytes.tpu:0|in_use", 5.0)
+    reg.set_gauge("device_mem_bytes.tpu:0|limit", 10.0)
+    reg.set_gauge("device_mem_headroom_bytes", 5.0)
+    text = render_families(families_from_snapshot(reg.snapshot()))
+    assert validate_exposition(text) == []
+    assert 'vft_device_mem_bytes{device="tpu:0",kind="in_use"} 5' in text
+    assert 'vft_device_mem_bytes{device="tpu:0",kind="limit"} 10' in text
+    assert "vft_device_mem_headroom_bytes 5" in text
+
+
+def test_check_exposition_negatives_for_new_families():
+    # counter naming: the checker must reject a miscast ledger family
+    bad_counter = ("# HELP vft_hbm_bytes x\n# TYPE vft_hbm_bytes counter\n"
+                   'vft_hbm_bytes{model="m",kind="resident"} 1\n')
+    assert any("_total" in e for e in check_exposition(bad_counter))
+    # sample without TYPE
+    orphan = 'vft_device_mem_bytes{device="tpu:0",kind="in_use"} 1\n'
+    assert check_exposition(orphan)
+    # bad label name
+    bad_label = ("# HELP vft_device_mem_bytes x\n"
+                 "# TYPE vft_device_mem_bytes gauge\n"
+                 'vft_device_mem_bytes{1bad="x"} 1\n')
+    assert check_exposition(bad_label)
+    # non-float value
+    bad_value = ("# HELP vft_hbm_bytes x\n# TYPE vft_hbm_bytes gauge\n"
+                 'vft_hbm_bytes{model="m"} lots\n')
+    assert check_exposition(bad_value)
+
+
+# --- device memory sampler --------------------------------------------------
+
+
+def test_sampler_absent_on_cpu():
+    # conftest pins JAX_PLATFORMS=cpu; CpuDevice.memory_stats() is None,
+    # so the sampler must leave the registry untouched — never zero-fill
+    reg = MetricsRegistry()
+    assert DeviceMemorySampler(reg).sample_once() == 0
+    snap = reg.snapshot()
+    assert not any(k.startswith("device_mem") for k in snap["gauges"])
+
+
+class _FakeDevice:
+    platform = "tpu"
+    id = 0
+
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_sampler_records_fake_device_stats_and_headroom():
+    reg = MetricsRegistry()
+    dev = _FakeDevice({"bytes_in_use": 600, "bytes_limit": 1000,
+                       "peak_bytes_in_use": 800})
+    s = DeviceMemorySampler(reg, devices=[dev])
+    assert s.sample_once() == 1
+    g = reg.snapshot()["gauges"]
+    assert g["device_mem_bytes.tpu:0|in_use"] == 600
+    assert g["device_mem_bytes.tpu:0|limit"] == 1000
+    assert g["device_mem_bytes.tpu:0|peak"] == 800
+    assert g["device_mem_headroom_bytes"] == 400
+    s.stop()  # idempotent without start()
+
+
+def test_format_bytes():
+    assert format_bytes(0) == "0 B"
+    assert format_bytes(1536) == "1.5 KiB"
+    assert format_bytes(953.7 * 2**20).endswith("MiB")
+
+
+# --- utilization timeline ---------------------------------------------------
+
+
+def _row(stage, t0, t1, pid=1, worker=None):
+    r = {"stage": stage, "t0": t0, "t1": t1, "pid": pid}
+    if worker:
+        r["worker"] = worker
+    return r
+
+
+def test_utilization_report_per_device_busy_idle():
+    rows = [
+        _row("decode", 0.0, 10.0),                       # host wall
+        _row("dispatch", 1.0, 3.0, worker="tpu:0"),
+        _row("fetch", 2.0, 5.0, worker="tpu:0"),         # overlaps -> merged
+        _row("h2d", 6.0, 8.0, worker="tpu:1"),
+    ]
+    rep = utilization_report(rows)
+    d0 = rep["devices"]["tpu:0"]
+    assert d0["busy_s"] == pytest.approx(4.0)  # [1,5] merged
+    assert d0["wall_s"] == pytest.approx(10.0)
+    assert d0["busy_frac"] == pytest.approx(0.4)
+    assert d0["idle_s"] == pytest.approx(6.0)
+    assert rep["devices"]["tpu:1"]["busy_s"] == pytest.approx(2.0)
+    assert rep["device_utilization"] == pytest.approx(6.0 / 20.0)
+
+
+def test_utilization_excludes_pids_without_device_spans():
+    rows = [_row("decode", 0.0, 100.0, pid=7)]  # host-only pid
+    rep = utilization_report(rows)
+    assert rep["devices"] == {}
+    assert rep["device_utilization"] == 0.0
+
+
+def test_chrome_trace_device_lanes_mirror_device_stages():
+    rows = [
+        _row("decode", 0.0, 1.0),
+        _row("dispatch", 1.0, 2.0, worker="tpu:0"),
+    ]
+    plain = spans_to_chrome_trace(rows)
+    lanes = spans_to_chrome_trace(rows, device_lanes=True)
+    names = [e["args"]["name"] for e in lanes["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert "device tpu:0" in names
+    # one mirrored X event per device span, none for host spans
+    assert (len([e for e in lanes["traceEvents"] if e["ph"] == "X"])
+            == len([e for e in plain["traceEvents"] if e["ph"] == "X"]) + 1)
+
+
+# --- `telemetry ledger` CLI -------------------------------------------------
+
+
+def test_ledger_cli_rc2_on_missing(tmp_path, capsys):
+    from video_features_tpu.telemetry.__main__ import main
+
+    assert main(["ledger", str(tmp_path / "none")]) == 2
+    assert "no ledger" in capsys.readouterr().err
+
+
+def test_ledger_cli_renders_table_and_json(tmp_path, capsys):
+    from video_features_tpu.telemetry.__main__ import main
+
+    led = CostLedger(str(tmp_path / LEDGER_FILENAME))
+    led.record("resnet18", "forward", "4x8", "queue", "cpu",
+               {"flops": 512.0, "bytes_accessed": 512.0,
+                "memory": {"argument_bytes": 384, "output_bytes": 128,
+                           "temp_bytes": 0, "generated_code_bytes": 0}})
+    assert main(["ledger", str(tmp_path)]) == 0  # dir resolution
+    out = capsys.readouterr().out
+    assert "resnet18" in out and "4x8" in out and "512" in out
+    assert "CPU-backend runs record flops only" in out
+    assert main(["ledger", str(tmp_path / LEDGER_FILENAME), "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["entries"][0]["bucket"] == "4x8"
+
+
+# --- bench --compare sentinel -----------------------------------------------
+
+
+def _bench_doc(value=3.6, **extra):
+    return {"n": 1, "cmd": "bench", "rc": 0,
+            "parsed": {"metric": "videos/s", "value": value, "unit": "videos/s",
+                       "vs_baseline": None, "extra": extra}}
+
+
+def test_compare_clean_pass_and_injected_regression():
+    import bench
+
+    bases = [_bench_doc(host_fps=100.0), _bench_doc(host_fps=104.0),
+             _bench_doc(host_fps=96.0)]
+    clean = bench.compare_bench(_bench_doc(host_fps=101.0), bases)
+    assert clean["regressed"] == []
+    assert clean["keys"]["host_fps"]["status"] == "ok"
+
+    reg = bench.compare_bench(_bench_doc(host_fps=40.0), bases)
+    assert "host_fps" in reg["regressed"]
+    # lower-better keys regress upward
+    lat_bases = [_bench_doc(warm_latency_s=0.1) for _ in range(3)]
+    worse = bench.compare_bench(_bench_doc(warm_latency_s=0.5), lat_bases)
+    assert "warm_latency_s" in worse["regressed"]
+    better = bench.compare_bench(_bench_doc(warm_latency_s=0.01), lat_bases)
+    assert "warm_latency_s" in better["improved"]
+    assert better["regressed"] == []
+
+
+def test_compare_budget_bool_is_a_hard_gate():
+    import bench
+
+    out = bench.compare_bench(_bench_doc(ledger_within_budget=False),
+                              [_bench_doc()])
+    assert "ledger_within_budget" in out["regressed"]
+    ok = bench.compare_bench(_bench_doc(ledger_within_budget=True),
+                             [_bench_doc()])
+    assert ok["regressed"] == []
+
+
+def test_compare_tolerates_sparse_bases_and_missing_keys():
+    import bench
+
+    # the committed trajectory shape: rc!=0 rounds carry no numbers
+    sparse = {"n": 2, "cmd": "bench", "rc": 3, "tail": "died", "parsed": {}}
+    out = bench.compare_bench(_bench_doc(host_fps=100.0),
+                              [_bench_doc(other_fps=5.0), sparse])
+    assert out["keys"]["other_fps"]["status"] == "missing"  # informational
+    assert out["keys"]["host_fps"]["status"] == "new"
+    assert out["regressed"] == []
+
+
+def test_compare_main_rc_contract(tmp_path):
+    import bench
+
+    base = tmp_path / "BENCH_base.json"
+    base.write_text(json.dumps(_bench_doc(host_fps=100.0)))
+    good = tmp_path / "cur_good.json"
+    good.write_text(json.dumps(_bench_doc(host_fps=99.0)))
+    bad = tmp_path / "cur_bad.json"
+    bad.write_text(json.dumps(_bench_doc(host_fps=10.0)))
+    out = tmp_path / "summary.json"
+    assert bench._compare_main([str(base), "--current", str(good)]) == 0
+    assert bench._compare_main(
+        [str(base), "--current", str(bad), "-o", str(out)]
+    ) == 1
+    assert json.loads(out.read_text())["regressed"] == ["host_fps"]
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"parsed": {}}))
+    assert bench._compare_main([str(empty), "--current", str(good)]) == 2
+
+
+def test_compare_passes_on_the_committed_trajectory():
+    import bench
+
+    bases = sorted(
+        p for p in os.listdir(".")
+        if p.startswith("BENCH_r") and p.endswith(".json")
+    )
+    if len(bases) < 2:
+        pytest.skip("no committed BENCH trajectory")
+    assert bench._compare_main([",".join(bases[:-1]), "--current", bases[-1]]) == 0
+
+
+# --- serve wiring -----------------------------------------------------------
+
+
+@pytest.mark.serve
+def test_daemon_ledger_block_and_hbm_budget(tmp_path):
+    from test_serve import ServeToy
+
+    from video_features_tpu.config import parse_serve_args
+    from video_features_tpu.serve.daemon import ServeDaemon
+
+    scfg = parse_serve_args([
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu", "--heartbeat_s", "0",
+        "--hbm_budget_bytes", "1000",
+    ])
+    d = ServeDaemon(scfg, build=ServeToy)
+    try:
+        assert d.stats()["ledger"]["entries"] == []
+        assert validate_exposition(d.metrics_text()) == []
+        d._check_hbm_budget()  # empty ledger: nothing projected, passes
+        assert d._warmup_hbm("resnet18") == "n/a"
+        d.ledger.record("resnet18", "forward", "1x3x64x96", "queue", "tpu",
+                        {"flops": 1.0, "memory": dict(TPU_MEM)})
+        assert d._warmup_hbm("resnet18") == format_bytes(1160)
+        with pytest.raises(RuntimeError, match="hbm_budget_bytes"):
+            d._check_hbm_budget()
+        text = d.metrics_text()
+        assert validate_exposition(text) == []
+        assert 'vft_hbm_bytes{kind="resident",model="resnet18"} 1160' in text
+    finally:
+        d.shutdown()
+
+
+@pytest.mark.serve
+def test_hbm_budget_knob_validation():
+    from video_features_tpu.config import parse_serve_args
+
+    with pytest.raises(ValueError, match="hbm_budget_bytes"):
+        parse_serve_args([
+            "--feature_types", "resnet18", "--allow_random_init", "--cpu",
+            "--hbm_budget_bytes", "-5",
+        ])

@@ -689,6 +689,9 @@ class ServeConfig:
     # warmup preflight specs, each "<feature_type>:<W>x<H>"
     warmup: List[str] = field(default_factory=list)
     warmup_only: bool = False
+    # fail warmup fast when the cost ledger's projected resident HBM for
+    # the resident models exceeds this many bytes (0 = unlimited)
+    hbm_budget_bytes: int = 0
 
     def warmup_pairs(self) -> List[tuple]:
         return [parse_warmup_spec(s) for s in self.warmup]
@@ -787,6 +790,11 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                    help="pre-build the fused executable for this "
                         "(feature_type, resolution) pair before accepting "
                         "traffic; repeatable")
+    g.add_argument("--hbm_budget_bytes", type=int, default=0,
+                   help="fail warmup when the cost ledger projects the "
+                        "resident models' HBM footprint past this many "
+                        "bytes (0 = unlimited; see docs/observability.md "
+                        "\"Device cost ledger\")")
     return p
 
 
@@ -824,6 +832,7 @@ def parse_serve_args(argv: Optional[Sequence[str]] = None) -> ServeConfig:
         retention_sweep_s=args.retention_sweep_s,
         warmup=list(args.warmup or []),
         warmup_only=warmup_only,
+        hbm_budget_bytes=args.hbm_budget_bytes,
     )
     return sanity_check_serve(scfg)
 
@@ -866,6 +875,8 @@ def sanity_check_serve(scfg: ServeConfig) -> ServeConfig:
         raise ValueError(f"max_request_records must be >= 1, got {scfg.max_request_records}")
     if scfg.retention_sweep_s < 0:
         raise ValueError(f"retention_sweep_s must be >= 0, got {scfg.retention_sweep_s}")
+    if scfg.hbm_budget_bytes < 0:
+        raise ValueError(f"hbm_budget_bytes must be >= 0, got {scfg.hbm_budget_bytes}")
     scfg.warmup_pairs()  # raises naming any bad spec
     if scfg.warmup_only and not scfg.warmup:
         raise ValueError("serve warmup needs at least one --warmup FEATURE_TYPE:WxH")

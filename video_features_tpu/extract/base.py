@@ -114,6 +114,20 @@ class BaseExtractor:
             # spans + ONE manifest warning per fn family exceeding its
             # committed per-bucket budget (analysis/compile_budget.json)
             self.telemetry.arm_recompile_watch(self.manifest)
+        # --- device cost ledger (telemetry/ledger.py; docs/observability.md)
+        # Save runs only (the same gate as the spans file): external/print
+        # runs — the GC401 budget scenarios, parity tests — never pay the
+        # analysis compile. warmup() wraps the built state dict so every
+        # executable's memory_analysis/cost_analysis lands in the ledger
+        # next to --compile_cache.
+        self.ledger = None
+        if wants_telemetry and tele_root is not None:
+            from video_features_tpu.telemetry.ledger import (
+                CostLedger,
+                default_ledger_path,
+            )
+
+            self.ledger = CostLedger.shared(default_ledger_path(self.config))
         faults.install_injector(getattr(self.config, "fault_inject", None))
         from video_features_tpu.io.probe import ResourceCaps
         from video_features_tpu.io.video import set_decode_timeout, set_resource_caps
@@ -256,7 +270,12 @@ class BaseExtractor:
         raise NotImplementedError
 
     def warmup(self, device) -> Any:
-        """Build (once) and cache this device's model state. Thread-safe."""
+        """Build (once) and cache this device's model state. Thread-safe.
+        On save runs the state dict's jitted callables are wrapped for
+        the device cost ledger (telemetry/ledger.py): the first call per
+        (fn family, signature) records the executable's flops/HBM facts
+        via a one-time AOT analysis compile; every call still executes
+        the original jit function."""
         key = device
         state = self._device_state.get(key)
         if state is None:
@@ -264,6 +283,18 @@ class BaseExtractor:
                 state = self._device_state.get(key)
                 if state is None:
                     state = self._build(device)
+                    if self.ledger is not None:
+                        from video_features_tpu.telemetry.ledger import (
+                            instrument_state,
+                        )
+
+                        state = instrument_state(
+                            state,
+                            self.ledger,
+                            model=self.feature_type,
+                            sharding=getattr(self.config, "sharding", "queue"),
+                            device=device,
+                        )
                     self._device_state[key] = state
         return state
 

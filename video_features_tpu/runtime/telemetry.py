@@ -96,6 +96,28 @@ _TARGETS: "weakref.WeakSet[Telemetry]" = weakref.WeakSet()
 # faults.install_injector): jax_log_compiles + the pxla log handler are
 # process state, so exactly one watch may be attached at a time
 _WATCH: Optional["RecompileWatch"] = None
+# thread-local flag raised while the cost ledger (telemetry/ledger.py)
+# runs its analysis-only AOT compile: jax emits its "Compiling <fn>"
+# log on the calling thread, so the watch can tell a ledger capture
+# from a real (re)compile and skip the event — the GC401 budgets and
+# the runtime allowance count executions, not bookkeeping
+_CAPTURE_LOCAL = threading.local()
+
+
+@contextmanager
+def suppress_compile_watch() -> Iterator[None]:
+    """Mark this thread's compile-log events as ledger-capture noise;
+    :meth:`RecompileWatch.on_compile` drops them. Reentrant."""
+    prev = getattr(_CAPTURE_LOCAL, "on", False)
+    _CAPTURE_LOCAL.on = True
+    try:
+        yield
+    finally:
+        _CAPTURE_LOCAL.on = prev
+
+
+def compile_watch_suppressed() -> bool:
+    return bool(getattr(_CAPTURE_LOCAL, "on", False))
 
 
 def set_current(tele: Optional["Telemetry"]) -> None:
@@ -713,6 +735,8 @@ class RecompileWatch:
         self._handler = None
 
     def on_compile(self, fn_name: str) -> None:
+        if compile_watch_suppressed():
+            return  # ledger analysis compile, not a real (re)build
         with self._lock:
             self.counts[fn_name] = self.counts.get(fn_name, 0) + 1
             count = self.counts[fn_name]
@@ -867,6 +891,66 @@ def overlap_report(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def _device_of_row(r: Dict[str, Any]) -> str:
+    """The device lane a span belongs to: the pipelined loop stamps
+    device spans with ``worker=str(device)`` (extract/base.py); spans
+    missing it (the serial loop, old files) share one per-pid lane."""
+    w = r.get("worker")
+    return str(w) if w else f"pid{int(r.get('pid', 0))}"
+
+
+def utilization_report(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-device busy/idle accounting over the device stages
+    (h2d/dispatch/fetch) — the per-device refinement of
+    :func:`overlap_report`. Busy time is the merged union of one
+    device's span intervals; wall time is per-pid (monotonic clocks
+    never compare across processes), taken over ALL stage spans so a
+    device idle while the host decodes counts as idle.
+
+    ``device_utilization`` is the headline fraction in summary.json:
+    total device-busy seconds / total device-lane wall seconds (each
+    pid's wall counted once per device it drove). 0.0 when no device
+    spans exist (serial loop, --telemetry off)."""
+    # pid -> (wall intervals over every stage, device -> intervals)
+    by_pid: Dict[int, Tuple[list, Dict[str, list]]] = {}
+    for r in rows:
+        t0, t1 = r.get("t0"), r.get("t1")
+        if t0 is None or t1 is None or t1 < t0:
+            continue
+        pid = int(r.get("pid", 0))
+        walls, devs = by_pid.setdefault(pid, ([], {}))
+        walls.append((float(t0), float(t1)))
+        if r.get("stage") in DEVICE_STAGES:
+            devs.setdefault(_device_of_row(r), []).append((float(t0), float(t1)))
+    devices: Dict[str, Dict[str, Any]] = {}
+    busy_total = wall_total = 0.0
+    for walls, devs in by_pid.values():
+        if not devs:
+            continue
+        merged_wall = _merged(walls)
+        pid_wall = (merged_wall[-1][1] - merged_wall[0][0]) if merged_wall else 0.0
+        for name, intervals in devs.items():
+            merged = _merged(intervals)
+            busy = sum(b - a for a, b in merged)
+            d = devices.setdefault(
+                name, {"busy_s": 0.0, "wall_s": 0.0, "spans": 0}
+            )
+            d["busy_s"] += busy
+            d["wall_s"] += pid_wall
+            d["spans"] += len(intervals)
+            busy_total += busy
+            wall_total += pid_wall
+    for d in devices.values():
+        d["busy_frac"] = (d["busy_s"] / d["wall_s"]) if d["wall_s"] > 0 else 0.0
+        d["idle_s"] = max(d["wall_s"] - d["busy_s"], 0.0)
+    return {
+        "devices": {k: devices[k] for k in sorted(devices)},
+        "device_busy_s": busy_total,
+        "device_wall_s": wall_total,
+        "device_utilization": (busy_total / wall_total) if wall_total > 0 else 0.0,
+    }
+
+
 def request_trace_rows(
     rows: Sequence[Dict[str, Any]], request_id: str
 ) -> List[Dict[str, Any]]:
@@ -936,17 +1020,31 @@ def request_trace_rows(
     return sorted(selected.values(), key=lambda r: (r.get("t0") or 0.0, r.get("seq", 0)))
 
 
-def spans_to_chrome_trace(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+# synthetic tid base for the per-device Perfetto lanes: far above any
+# real thread ident so lanes never collide with OS thread ids
+_DEVICE_LANE_TID_BASE = 1 << 22
+
+
+def spans_to_chrome_trace(
+    rows: Sequence[Dict[str, Any]], device_lanes: bool = False
+) -> Dict[str, Any]:
     """Chrome-trace ("Trace Event Format") JSON from span rows, loadable
     in Perfetto / chrome://tracing. Complete ("X") events with µs
     ``ts``/``dur`` rebased to the earliest span, plus thread_name
-    metadata so lanes are labelled decode-*/worker threads."""
+    metadata so lanes are labelled decode-*/worker threads.
+
+    ``device_lanes=True`` (``telemetry export --device-lanes``)
+    additionally mirrors every device-stage span (h2d/dispatch/fetch)
+    into one synthetic ``device <name>`` lane per device, so the
+    busy/idle timeline :func:`utilization_report` summarizes is visible
+    as a row per chip rather than scattered across dispatcher threads."""
     events: List[Dict[str, Any]] = []
     t_base = min(
         (float(r["t0"]) for r in rows if r.get("t0") is not None),
         default=0.0,
     )
     seen_threads: set = set()
+    device_tids: Dict[Tuple[int, str], int] = {}
     for r in rows:
         t0, t1 = r.get("t0"), r.get("t1")
         if t0 is None or t1 is None:
@@ -965,7 +1063,7 @@ def spans_to_chrome_trace(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             if k not in ("stage", "t0", "t1", "pid", "thread", "thread_name")
             and v is not None
         }
-        events.append({
+        ev = {
             "ph": "X",
             "name": r.get("stage", "?"),
             "cat": r.get("stage", "?"),
@@ -974,7 +1072,20 @@ def spans_to_chrome_trace(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             "pid": pid,
             "tid": tid,
             "args": args,
-        })
+        }
+        events.append(ev)
+        if device_lanes and r.get("stage") in DEVICE_STAGES:
+            dev = _device_of_row(r)
+            lane_key = (pid, dev)
+            lane_tid = device_tids.get(lane_key)
+            if lane_tid is None:
+                lane_tid = _DEVICE_LANE_TID_BASE + len(device_tids)
+                device_tids[lane_key] = lane_tid
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": lane_tid, "args": {"name": f"device {dev}"},
+                })
+            events.append({**ev, "tid": lane_tid})
     events.sort(key=lambda e: (e.get("ts", -1), e["ph"] != "M"))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -1063,5 +1174,10 @@ def collect(output_root: str) -> Optional[Dict[str, Any]]:
         block = {}
     if rows:
         block["overlap"] = overlap_report(rows)
+        # the per-device busy/idle refinement; its device_utilization
+        # fraction is THE headline the fleet-scale placement work reads
+        util = utilization_report(rows)
+        block["utilization"] = util
+        block["device_utilization"] = util["device_utilization"]
         block["span_files"] = [os.path.basename(p) for p in span_paths]
     return block

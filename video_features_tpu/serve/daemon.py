@@ -62,9 +62,16 @@ from video_features_tpu.serve.supervisor import (
 )
 from video_features_tpu.telemetry.exposition import (
     Family,
+    families_from_ledger,
     families_from_snapshot,
     group_service_metric,
     render_families,
+)
+from video_features_tpu.telemetry.ledger import (
+    CostLedger,
+    DeviceMemorySampler,
+    default_ledger_path,
+    format_bytes,
 )
 
 
@@ -252,6 +259,16 @@ class ServeDaemon:
         # live on the daemon's (injectable) scheduling clock
         self.slo = SloTracker(window_s=scfg.slo_window_s, clock=clock)
         self.cost_model = ServiceTimeModel(path=default_model_path(self.cfg))
+        # device cost ledger: the pooled extractors record every built
+        # executable's cost/memory analysis here (extract/base.py wraps
+        # state callables on warmup); shared() so daemon and extractors
+        # see one object per path. The sampler polls device.memory_stats
+        # into the registry (absent on backends without the API, e.g. CPU)
+        self.ledger = CostLedger.shared(default_ledger_path(self.cfg))
+        self.sampler = DeviceMemorySampler(
+            self.telemetry.metrics,
+            interval_s=max(float(self.cfg.heartbeat_s or 0.0), 10.0),
+        )
         self.tracker = RequestTracker(
             self.cfg.output_path, telemetry=self.telemetry,
             slo=self.slo, clock=clock,
@@ -647,8 +664,32 @@ class ServeDaemon:
             print(
                 f"serve: warmup {ft} {w}x{h}: {rec.get('state', '?')}"
                 + (f" ({rec.get('message')})" if rec.get("state") == "failed" else "")
+                + f" hbm={self._warmup_hbm(ft)}"
             )
+        self._check_hbm_budget()
         return out
+
+    def _warmup_hbm(self, feature_type: str) -> str:
+        """The ledger's projected resident HBM for one model, for the
+        warmup line — 'n/a' when the ledger has no HBM-platform entries
+        for it (CPU backends record flops only)."""
+        proj = self.ledger.hbm_projection().get(feature_type)
+        return format_bytes(proj["resident"]) if proj else "n/a"
+
+    def _check_hbm_budget(self) -> None:
+        """Fail warmup fast when the projected resident set for ALL the
+        resident models exceeds --hbm_budget_bytes (0 = unlimited)."""
+        budget = int(self.scfg.hbm_budget_bytes or 0)
+        if budget <= 0:
+            return
+        projected = self.ledger.projected_resident_bytes(self.scfg.feature_types)
+        if projected > budget:
+            raise RuntimeError(
+                f"serve: projected resident HBM {format_bytes(projected)} "
+                f"exceeds --hbm_budget_bytes {format_bytes(budget)} for "
+                f"models {', '.join(self.scfg.feature_types)} — shrink the "
+                "resident set or raise the budget"
+            )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -660,6 +701,7 @@ class ServeDaemon:
             self._started = True
         if self.scfg.warmup:
             self.warmup()
+        self.sampler.start()
         self.batcher.start()
         if self.scfg.retention_sweep_s > 0:
             self._sweep_thread = threading.Thread(
@@ -724,6 +766,7 @@ class ServeDaemon:
         out["slo"] = self.slo.snapshot()
         out["cost_model"] = self.cost_model.snapshot()
         out["metrics"] = self.telemetry.metrics.snapshot()
+        out["ledger"] = self.ledger.snapshot()
         return out
 
     def metrics_text(self) -> str:
@@ -733,6 +776,7 @@ class ServeDaemon:
         families rendered directly from live daemon state (breakers,
         SLO quantiles, uptime, watchdog)."""
         fams = families_from_snapshot(self.telemetry.metrics.snapshot())
+        fams.extend(families_from_ledger(self.ledger.snapshot()))
         fams.extend(self._serve_families())
         return render_families(fams)
 
@@ -819,6 +863,9 @@ class ServeDaemon:
         )
         if open_breakers:
             line += " breakers_open=" + ",".join(open_breakers)
+        headroom = snap["gauges"].get("device_mem_headroom_bytes")
+        if headroom is not None:
+            line += f" hbm_headroom={format_bytes(int(headroom))}"
         return line
 
     def shutdown(self, drain: bool = True) -> None:
@@ -842,6 +889,7 @@ class ServeDaemon:
             self._sweep_stop.set()
             self._sweep_thread.join()
             self._sweep_thread = None
+        self.sampler.stop()  # idempotent; no-op when start() never ran
         for req in self.batcher.close(drain=drain):
             if req.source == "spool" and self.scfg.spool_dir:
                 self.tracker.requeue(req, self.scfg.spool_dir)

@@ -16,9 +16,16 @@ Consumers for the span files a run leaves under ``<output>/_telemetry/``:
   sink, plus the worker-thread decode/prepare spans for the request's
   video) assembled across the daemon's and the resident extractor's
   spans files. See docs/observability.md "Live serve metrics".
+- ``ledger PATH [--json]`` — render the device cost ledger
+  (telemetry/ledger.py): per-(model, fn family, bucket, sharding)
+  flops / bytes-accessed / memory_analysis bytes, plus the per-model
+  resident-HBM projection. PATH is the ledger JSON, a ``--compile_cache``
+  directory, or a run's output root. See docs/observability.md
+  "Device cost ledger".
 
-Exit codes: 0 ok, 2 usage error / no spans found. No jax import — these
-run fine on a laptop against files rsynced off a TPU host.
+Exit codes: 0 ok, 2 usage error / no spans found / no ledger at PATH.
+No jax import — these run fine on a laptop against files rsynced off a
+TPU host.
 """
 
 from __future__ import annotations
@@ -28,7 +35,7 @@ import glob
 import json
 import os
 import sys
-from typing import List
+from typing import Any, List
 
 from video_features_tpu.runtime.telemetry import (
     overlap_report,
@@ -51,6 +58,72 @@ def _resolve_span_files(paths: List[str]) -> List[str]:
     return out
 
 
+def _resolve_ledger_path(path: str) -> str:
+    """PATH may be the ledger file itself, a --compile_cache directory,
+    or a run's output root (ledger under ``_telemetry/``)."""
+    from video_features_tpu.telemetry.ledger import LEDGER_FILENAME
+
+    if os.path.isdir(path):
+        for candidate in (
+            os.path.join(path, LEDGER_FILENAME),
+            os.path.join(path, "_telemetry", LEDGER_FILENAME),
+        ):
+            if os.path.isfile(candidate):
+                return candidate
+        return os.path.join(path, LEDGER_FILENAME)  # for the error message
+    return path
+
+
+def _ledger_main(args: Any) -> int:
+    from video_features_tpu.telemetry.ledger import format_bytes, load_ledger
+
+    path = _resolve_ledger_path(args.path)
+    ledger = load_ledger(path)
+    if ledger is None:
+        print(f"telemetry: no ledger at {path}", file=sys.stderr)
+        return 2
+    snap = ledger.snapshot()
+    if args.json:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    entries = snap["entries"]
+    print(f"ledger: {path} ({len(entries)} executable(s))")
+    header = (
+        f"{'model':<20} {'family':<20} {'bucket':<16} {'sharding':<8} "
+        f"{'platform':<8} {'flops':>12} {'moved':>10} {'hbm args':>10} "
+        f"{'temp':>10}"
+    )
+    print(header)
+    print("-" * len(header))
+    for e in entries:
+        mem = e.get("memory", {})
+        flops = e.get("flops")
+        moved = e.get("bytes_accessed")
+        print(
+            f"{e.get('model', '~'):<20} {e.get('family', '~'):<20} "
+            f"{e.get('bucket', '~'):<16} {e.get('sharding', '~'):<8} "
+            f"{e.get('platform', '~'):<8} "
+            f"{(f'{flops:.3g}' if flops is not None else '-'):>12} "
+            f"{(format_bytes(moved) if moved is not None else '-'):>10} "
+            f"{(format_bytes(mem['argument_bytes']) if 'argument_bytes' in mem else '-'):>10} "
+            f"{(format_bytes(mem['temp_bytes']) if 'temp_bytes' in mem else '-'):>10}"
+        )
+    proj = snap["hbm_projection"]
+    if proj:
+        print("projected resident HBM per model:")
+        for model, p in sorted(proj.items()):
+            print(
+                f"  {model}: {format_bytes(p['resident'])} "
+                f"(arguments {format_bytes(p['arguments'])}, outputs "
+                f"{format_bytes(p['outputs'])}, temp {format_bytes(p['temp'])}, "
+                f"code {format_bytes(p['generated_code'])})"
+            )
+    else:
+        print("projected resident HBM: none (no HBM-platform entries — "
+              "CPU-backend runs record flops only)")
+    return 0
+
+
 def main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m video_features_tpu.telemetry",
@@ -62,6 +135,9 @@ def main(argv: List[str]) -> int:
                           help="spans-*.jsonl files, a _telemetry dir, or an output root")
     p_export.add_argument("-o", "--output", default=None,
                           help="trace JSON path (default: stdout)")
+    p_export.add_argument("--device-lanes", action="store_true",
+                          help="mirror device-stage spans (h2d/dispatch/"
+                               "fetch) into one Perfetto lane per device")
     p_report = sub.add_parser("report", help="overlap-efficiency summary")
     p_report.add_argument("paths", nargs="+",
                           help="spans-*.jsonl files, a _telemetry dir, or an output root")
@@ -74,7 +150,19 @@ def main(argv: List[str]) -> int:
                          help="spans-*.jsonl files, a _telemetry dir, or an output root")
     p_trace.add_argument("-o", "--output", default=None,
                          help="trace JSON path (default: stdout)")
+    p_ledger = sub.add_parser(
+        "ledger", help="render the device cost ledger (flops/HBM per executable)"
+    )
+    p_ledger.add_argument(
+        "path",
+        help="cost_ledger.json, a --compile_cache dir, or an output root",
+    )
+    p_ledger.add_argument("--json", action="store_true",
+                          help="emit the raw ledger snapshot")
     args = parser.parse_args(argv)
+
+    if args.cmd == "ledger":
+        return _ledger_main(args)
 
     files = _resolve_span_files(args.paths)
     rows = []
@@ -97,7 +185,9 @@ def main(argv: List[str]) -> int:
                     file=sys.stderr,
                 )
                 return 2
-        trace = spans_to_chrome_trace(rows)
+        trace = spans_to_chrome_trace(
+            rows, device_lanes=getattr(args, "device_lanes", False)
+        )
         text = json.dumps(trace)
         if args.output:
             with open(args.output, "w", encoding="utf-8") as f:

@@ -165,6 +165,18 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 "Live queue depths by queue name (admission = requests "
                 "admitted but not yet terminal; the backpressure bound).",
             ).add({"queue": name[len("queue_depth."):]}, value)
+        elif name.startswith("device_mem_bytes."):
+            # DeviceMemorySampler gauges: "device_mem_bytes.<device>|<kind>"
+            # (absent entirely on backends without device.memory_stats())
+            dev, _, kind = name[len("device_mem_bytes."):].partition(
+                GROUP_SERVICE_SEP
+            )
+            fam(
+                f"{METRIC_PREFIX}device_mem_bytes", "gauge",
+                "Live device memory by device and kind (in_use/limit/"
+                "peak/reserved), polled from device.memory_stats(); "
+                "absent on backends without the API.",
+            ).add({"device": dev, "kind": kind or "~"}, value)
         else:
             fam(
                 f"{METRIC_PREFIX}{sanitize_metric_name(name)}", "gauge",
@@ -191,6 +203,56 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 f"Registry histogram {name!r}.",
             ).add(None, hist)
     return list(fams.values())
+
+
+# -- ledger snapshot -> families -----------------------------------------
+
+
+def families_from_ledger(snapshot: Dict[str, Any]) -> List[Family]:
+    """Exposition families from a CostLedger snapshot
+    (telemetry/ledger.py): per-executable flops / bytes-accessed for
+    every entry (present on any backend — CPU included, the
+    cost_analysis API is portable), and the per-model resident-HBM
+    projection ``vft_hbm_bytes{model,kind}`` — which only exists for
+    entries built on an HBM platform, so a CPU daemon's /metrics
+    legitimately has no ``vft_hbm_*`` series."""
+    fams: List[Family] = []
+    f_flops = Family(
+        f"{METRIC_PREFIX}executable_flops", "gauge",
+        "Flops per built executable (cost_analysis), keyed by model, "
+        "fn family, spatial bucket, and sharding mode.",
+    )
+    f_moved = Family(
+        f"{METRIC_PREFIX}executable_bytes_accessed", "gauge",
+        "Bytes accessed per built executable (cost_analysis).",
+    )
+    for e in snapshot.get("entries", []):
+        labels = {
+            "model": str(e.get("model", "~")),
+            "family": str(e.get("family", "~")),
+            "bucket": str(e.get("bucket", "~")),
+            "sharding": str(e.get("sharding", "~")),
+        }
+        if "flops" in e:
+            f_flops.add(labels, e["flops"])
+        if "bytes_accessed" in e:
+            f_moved.add(labels, e["bytes_accessed"])
+    if f_flops.samples:
+        fams.append(f_flops)
+    if f_moved.samples:
+        fams.append(f_moved)
+    f_hbm = Family(
+        f"{METRIC_PREFIX}hbm_bytes", "gauge",
+        "Projected resident HBM bytes per model and kind (arguments/"
+        "outputs/temp/generated_code/resident), from memory_analysis "
+        "of each built executable; absent on CPU backends.",
+    )
+    for model, proj in sorted(snapshot.get("hbm_projection", {}).items()):
+        for kind, v in sorted(proj.items()):
+            f_hbm.add({"model": model, "kind": kind}, v)
+    if f_hbm.samples:
+        fams.append(f_hbm)
+    return fams
 
 
 # -- the checker ---------------------------------------------------------
@@ -409,3 +471,8 @@ def validate_exposition(text: str) -> List[str]:
                     f"{where}: _count {slot['count']} != +Inf bucket {vals[-1]}"
                 )
     return errors
+
+
+# the name the tests and docs use for the read side; same contract as
+# validate_exposition (returns the error list, empty == valid)
+check_exposition = validate_exposition

@@ -1,0 +1,566 @@
+"""Device cost ledger: what each built executable *costs*.
+
+PR 12's cost model (serve/costmodel.py) learns wall-clock service time;
+nothing measured what an executable costs in device terms — HBM resident
+bytes, flops, bytes moved — so the ROADMAP's HBM-aware preemption /
+placement items would be guessing. This module records those facts at
+the only seam that has them: the compiled executable itself, via the
+portable JAX AOT APIs (``jitted.lower(*args).compile()`` →
+``memory_analysis()`` / ``cost_analysis()``) — the VirtualFlow framing:
+no TPU-only tooling, the same accounting on any backend.
+
+Three pieces:
+
+- :class:`CostLedger` — the persistent ledger: one entry per
+  (model, fn family, spatial bucket, sharding mode), carrying flops /
+  bytes-accessed (``cost_analysis``) and the argument / output / temp /
+  generated-code byte sizes (``memory_analysis``) plus the platform the
+  executable was built for. Persistence mirrors
+  serve/costmodel.py::ServiceTimeModel: atomic ``os.replace`` rewrite
+  next to ``--compile_cache`` (the other warm-start artifact), torn /
+  missing files load silently as empty, snapshot under the lock but
+  file I/O outside it (GC312). :meth:`CostLedger.shared` hands every
+  component of one process (daemon + pooled extractors) the same
+  instance per path, so /metrics and the warmup budget see one ledger.
+- :func:`instrument_state` — the capture seam. ``BaseExtractor.warmup``
+  wraps the built state dict's jitted callables; the first call per
+  (family, argument signature) runs a one-time AOT
+  ``lower().compile()`` purely for analysis (execution stays on the
+  proven jit path), under
+  :func:`~video_features_tpu.runtime.telemetry.suppress_compile_watch`
+  so the analysis compile is never double-counted by RecompileWatch.
+- :class:`DeviceMemorySampler` — live gauges: a thread polling
+  ``device.memory_stats()`` into the MetricsRegistry
+  (``device_mem_bytes.<device>|<kind>``, rendered as
+  ``vft_device_mem_bytes{device,kind}``). Backends without the API
+  (CPU, old jax) degrade to **absent** gauges — never zero-filled.
+
+HBM semantics: ``memory_analysis`` figures are recorded wherever the
+API answers (they are honest host-byte sizes on CPU too), but the
+``vft_hbm_bytes{model,kind}`` projection and the warmup
+``--hbm_budget_bytes`` gate only count entries whose platform has HBM
+(anything except ``cpu``) — on a CPU backend the HBM families are
+legitimately absent.
+
+No jax at module scope (the ``python -m video_features_tpu.telemetry``
+CLI renders ledgers on laptops); jax is imported lazily inside the
+capture/sampling paths, which only run where jax already runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+LEDGER_FILENAME = "cost_ledger.json"
+SCHEMA_VERSION = 1
+
+# entry-key separator; shared with the exposition conventions ('|' never
+# appears in a feature type, fn family, WxH/shape bucket, or sharding mode)
+KEY_SEP = "|"
+
+# state-dict slots that are not jitted callables (extract/*/_build)
+_NON_CALLABLE_KEYS = frozenset({"params", "device", "mesh"})
+
+# memory_analysis attribute -> ledger field (absent attributes and
+# failing calls leave the field out entirely — never zero-filled)
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+# device.memory_stats() key -> gauge kind label
+_MEMSTAT_KINDS = (
+    ("bytes_in_use", "in_use"),
+    ("bytes_limit", "limit"),
+    ("peak_bytes_in_use", "peak"),
+    ("bytes_reserved", "reserved"),
+)
+
+
+def default_ledger_path(cfg: Any) -> str:
+    """Where the ledger persists: next to the compile cache when one is
+    configured (the executables it describes live there), else under the
+    run's ``_telemetry`` directory — the same rule as the service-time
+    model (serve/costmodel.py::default_model_path)."""
+    cache = getattr(cfg, "compile_cache", None)
+    if cache:
+        return os.path.join(cache, LEDGER_FILENAME)
+    return os.path.join(cfg.output_path, "_telemetry", LEDGER_FILENAME)
+
+
+def entry_key(model: str, family: str, bucket: str, sharding: str) -> str:
+    return KEY_SEP.join((model, family, bucket, sharding))
+
+
+def analyze_compiled(compiled: Any) -> Dict[str, Any]:
+    """Portable cost/memory facts from one ``jax.stages.Compiled``.
+
+    Returns any of ``flops`` / ``bytes_accessed`` (cost_analysis) and a
+    ``memory`` sub-dict (memory_analysis); each piece is omitted when
+    the backend does not answer (old jax, exotic runtimes) — the
+    graceful-degradation contract is *absent*, never zero."""
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            flops = ca.get("flops")
+            if flops is not None and float(flops) >= 0:
+                out["flops"] = float(flops)
+            moved = ca.get("bytes accessed")
+            if moved is not None and float(moved) >= 0:
+                out["bytes_accessed"] = float(moved)
+    except Exception:  # noqa: BLE001 - observability must never kill the run
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        mem: Dict[str, int] = {}
+        for attr, field in _MEMORY_FIELDS:
+            v = getattr(ma, attr, None)
+            if v is not None and int(v) >= 0:
+                mem[field] = int(v)
+        if mem:
+            out["memory"] = mem
+    except Exception:  # noqa: BLE001 - graceful degradation: no memory block
+        pass
+    return out
+
+
+class CostLedger:
+    """Per-executable cost facts keyed by (model, family, bucket,
+    sharding), persisted like the service-time model. Thread-safe: the
+    capture path records from extractor build/dispatch threads while
+    /metrics snapshots from HTTP handler threads; no I/O under the
+    lock (GC312)."""
+
+    _SHARED_LOCK = threading.Lock()
+    _SHARED: Dict[str, "CostLedger"] = {}
+
+    def __init__(self, path: Optional[str] = None, save_every: int = 1) -> None:
+        # save_every=1: captures happen once per (family, signature) —
+        # a handful per run — so every record can afford its atomic
+        # rewrite, and a short run (or a crash) never loses the ledger.
+        self.path = path
+        self.save_every = max(int(save_every), 1)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        self._dirty = 0
+        if path is not None:
+            self._load(path)
+
+    @classmethod
+    def shared(cls, path: str) -> "CostLedger":
+        """The process-shared instance for ``path`` (normalized): the
+        daemon and every pooled extractor must append to ONE ledger so
+        the /metrics projection and the warmup budget agree."""
+        key = os.path.abspath(path)
+        with cls._SHARED_LOCK:
+            led = cls._SHARED.get(key)
+            if led is None:
+                led = cls._SHARED[key] = cls(key)
+            return led
+
+    # -- the write side (extractor build / first-dispatch threads) -------
+
+    def record(
+        self,
+        model: str,
+        family: str,
+        bucket: str,
+        sharding: str,
+        platform: Optional[str],
+        analysis: Dict[str, Any],
+    ) -> None:
+        """Fold one executable's analysis in. Re-records of the same key
+        (a rebuilt extractor, a daemon restart against the same compile
+        cache) overwrite the facts and bump ``n_compiles``."""
+        entry: Dict[str, Any] = {
+            "model": model,
+            "family": family,
+            "bucket": bucket,
+            "sharding": sharding,
+        }
+        if platform:
+            entry["platform"] = str(platform)
+        for k in ("flops", "bytes_accessed", "memory"):
+            if k in analysis:
+                entry[k] = analysis[k]
+        key = entry_key(model, family, bucket, sharding)
+        save_now = False
+        with self._lock:
+            prev = self._entries.get(key)
+            entry["n_compiles"] = (prev.get("n_compiles", 0) if prev else 0) + 1
+            self._entries[key] = entry
+            self._dirty += 1
+            if self.path is not None and self._dirty >= self.save_every:
+                self._dirty = 0
+                save_now = True
+        if save_now:
+            self.save()
+
+    # -- the read side (/metrics, /v1/stats, warmup, CLI) ----------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for _, e in sorted(self._entries.items())]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /v1/stats ``ledger`` block: the entries plus the
+        per-model HBM projection."""
+        return {
+            "version": SCHEMA_VERSION,
+            "path": self.path,
+            "entries": self.entries(),
+            "hbm_projection": self.hbm_projection(),
+        }
+
+    def hbm_projection(self) -> Dict[str, Dict[str, int]]:
+        """Per-model projected resident-HBM bytes, from entries built
+        for a platform that *has* HBM (anything except cpu; entries
+        with no platform or no memory block are skipped — CPU runs
+        project nothing, by design).
+
+        The projection is a deliberate approximation, documented in
+        docs/observability.md: arguments (weights + the largest input
+        batch) / outputs / temp are MAXed across a model's entries —
+        the weights dominate ``argument_bytes`` and are shared by every
+        bucket variant, so summing would multiply the model by its
+        bucket count — while generated code is SUMMED (each executable's
+        program stays resident). ``resident`` is their total: the
+        peak-executable footprint with every bucket variant loaded."""
+        out: Dict[str, Dict[str, int]] = {}
+        for e in self.entries():
+            platform = e.get("platform")
+            mem = e.get("memory")
+            if not mem or not platform or platform == "cpu":
+                continue
+            proj = out.setdefault(e["model"], {
+                "arguments": 0, "outputs": 0, "temp": 0, "generated_code": 0,
+            })
+            proj["arguments"] = max(proj["arguments"], mem.get("argument_bytes", 0))
+            proj["outputs"] = max(proj["outputs"], mem.get("output_bytes", 0))
+            proj["temp"] = max(proj["temp"], mem.get("temp_bytes", 0))
+            proj["generated_code"] += mem.get("generated_code_bytes", 0)
+        for proj in out.values():
+            proj["resident"] = (
+                proj["arguments"] + proj["outputs"]
+                + proj["temp"] + proj["generated_code"]
+            )
+        return out
+
+    def projected_resident_bytes(self, models: Optional[Sequence[str]] = None) -> int:
+        """Total projected resident set across ``models`` (default: every
+        model in the ledger) — the number the serve warmup checks
+        against ``--hbm_budget_bytes``. 0 on CPU backends (no HBM
+        entries), so the budget gate is trivially satisfied there."""
+        proj = self.hbm_projection()
+        if models is not None:
+            proj = {m: p for m, p in proj.items() if m in models}
+        return sum(p["resident"] for p in proj.values())
+
+    # -- persistence (the costmodel pattern) -----------------------------
+
+    def save(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomic rewrite: snapshot under the lock, write outside it."""
+        path = path or self.path
+        if path is None:
+            return None
+        with self._lock:
+            doc = {"version": SCHEMA_VERSION, "entries": dict(self._entries)}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return  # no/torn prior ledger: start cold
+        if not isinstance(doc, dict) or doc.get("version") != SCHEMA_VERSION:
+            return
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            return
+        with self._lock:
+            for key, e in entries.items():
+                if isinstance(e, dict) and "model" in e and "family" in e:
+                    self._entries[str(key)] = e
+
+
+def load_ledger(path: str) -> Optional[CostLedger]:
+    """Read-side open for the CLI: None when the file is missing (the
+    rc-2 contract lives in telemetry/__main__.py); a torn file loads
+    as an empty ledger, like every other warm-start artifact."""
+    if not os.path.isfile(path):
+        return None
+    return CostLedger(path)
+
+
+# -- the capture seam -----------------------------------------------------
+
+
+def _array_leaves(tree: Any) -> List[Any]:
+    """Array-ish leaves of a nested args structure, pure python (no jax
+    import: shapes are all the signature needs)."""
+    out: List[Any] = []
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        elif hasattr(node, "shape") and hasattr(node, "dtype"):
+            out.append(node)
+    return out
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in _array_leaves((args, kwargs))
+    )
+
+
+def bucket_of(args: tuple, kwargs: dict = {}) -> str:  # noqa: B006 - read-only default
+    """The ledger's spatial-bucket string for one call: the shape of the
+    largest data leaf, ``"24x240x448x3"``-style. Model params (a leading
+    mapping arg, the ``fn(params, x)`` convention) are excluded so the
+    bucket tracks the *input*, not the weights; ``"~"`` when no data
+    leaf exists (nullary warms)."""
+    data_args = args[1:] if args and isinstance(args[0], dict) else args
+    leaves = _array_leaves((data_args, kwargs))
+    if not leaves:
+        return "~"
+    best = max(leaves, key=lambda a: (len(a.shape), _leaf_size(a)))
+    return "x".join(str(int(d)) for d in best.shape) or "scalar"
+
+
+def _leaf_size(a: Any) -> int:
+    n = 1
+    for d in a.shape:
+        n *= int(d)
+    return n
+
+
+def _platform_name(device: Any) -> Optional[str]:
+    p = getattr(device, "platform", None)
+    if p:
+        return str(p)
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001 - no backend, no platform tag
+        return None
+
+
+def instrument_state(
+    state: Any,
+    ledger: CostLedger,
+    model: str,
+    sharding: str = "queue",
+    device: Any = None,
+) -> Any:
+    """Wrap an extractor's built state dict so every jitted callable's
+    first call per argument signature captures its executable's
+    cost/memory analysis into ``ledger``.
+
+    The fn family is the state-dict key (``forward`` / ``encode_image``
+    / ``forward_raw_group`` …, the GC401 budget vocabulary). Execution
+    is untouched — the wrapper forwards to the original jitted fn; the
+    analysis runs a one-time AOT ``lower().compile()`` on the side,
+    inside :func:`~video_features_tpu.runtime.telemetry.
+    suppress_compile_watch` so RecompileWatch (and its manifest
+    warnings) never count it. Any analysis failure is swallowed: the
+    ledger is observability, the dispatch must win every race with it.
+
+    Non-dict states and non-jit values pass through unchanged."""
+    if not isinstance(state, dict):
+        return state
+    platform = _platform_name(device if device is not None else state.get("device"))
+    out = dict(state)
+    for family, fn in state.items():
+        if family in _NON_CALLABLE_KEYS or not callable(fn):
+            continue
+        if not hasattr(fn, "lower"):  # jit-wrapped callables only
+            continue
+        out[family] = _wrap_callable(fn, ledger, model, family, sharding, platform)
+    return out
+
+
+def _wrap_callable(
+    fn: Callable,
+    ledger: CostLedger,
+    model: str,
+    family: str,
+    sharding: str,
+    platform: Optional[str],
+) -> Callable:
+    seen: set = set()
+    lock = threading.Lock()
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        try:
+            sig = _signature(args, kwargs)
+            with lock:
+                first = sig not in seen
+                if first:
+                    seen.add(sig)
+        except Exception:  # noqa: BLE001 - signature failure: skip capture
+            first = False
+        if first:
+            # analysis OUTSIDE the lock (GC312: a compile is blocking
+            # I/O as far as any other thread's dispatch is concerned)
+            _capture(fn, args, kwargs, ledger, model, family, sharding, platform)
+        return fn(*args, **kwargs)
+
+    wrapped.__wrapped_for_ledger__ = fn  # type: ignore[attr-defined]
+    return wrapped
+
+
+def _capture(
+    fn: Callable,
+    args: tuple,
+    kwargs: dict,
+    ledger: CostLedger,
+    model: str,
+    family: str,
+    sharding: str,
+    platform: Optional[str],
+) -> None:
+    from video_features_tpu.runtime.telemetry import suppress_compile_watch
+
+    try:
+        with suppress_compile_watch():
+            compiled = fn.lower(*args, **kwargs).compile()
+        analysis = analyze_compiled(compiled)
+    except Exception:  # noqa: BLE001 - observability must never kill dispatch
+        return
+    if not analysis:
+        return  # backend answered nothing: omit the entry, don't zero-fill
+    ledger.record(
+        model, family, bucket_of(args, kwargs), sharding, platform, analysis
+    )
+
+
+# -- live device-memory gauges -------------------------------------------
+
+
+class DeviceMemorySampler:
+    """Polls ``device.memory_stats()`` into a MetricsRegistry as
+    ``device_mem_bytes.<device>|<kind>`` gauges plus a cross-device
+    ``device_mem_headroom_bytes`` minimum (limit - in_use), for
+    /metrics and the serve heartbeat.
+
+    Backends whose devices lack the API or return None (CPU) set **no**
+    gauges — the exposition simply has no ``vft_device_mem_*`` families
+    there, per the degradation contract. ``sample_once()`` is public so
+    tests and the warmup path can poll synchronously; ``start``/``stop``
+    run it on a daemon thread."""
+
+    def __init__(
+        self,
+        metrics: Any,
+        interval_s: float = 10.0,
+        devices: Optional[Sequence[Any]] = None,
+    ) -> None:
+        self.metrics = metrics
+        self.interval_s = max(float(interval_s), 0.5)
+        self._devices = list(devices) if devices is not None else None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _resolve_devices(self) -> List[Any]:
+        if self._devices is not None:
+            return self._devices
+        try:
+            import jax
+
+            return list(jax.local_devices())
+        except Exception:  # noqa: BLE001 - no jax/backend: nothing to sample
+            return []
+
+    def sample_once(self) -> int:
+        """One poll; returns the number of per-device stat sets
+        recorded (0 on backends without the API)."""
+        recorded = 0
+        headroom: Optional[int] = None
+        for dev in self._resolve_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:  # noqa: BLE001 - API absent on this backend
+                stats = None
+            if not isinstance(stats, dict):
+                continue
+            name = f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+            got = False
+            for stat_key, kind in _MEMSTAT_KINDS:
+                v = stats.get(stat_key)
+                if isinstance(v, (int, float)):
+                    self.metrics.set_gauge(
+                        f"device_mem_bytes.{name}{KEY_SEP}{kind}", float(v)
+                    )
+                    got = True
+            if got:
+                recorded += 1
+            limit, used = stats.get("bytes_limit"), stats.get("bytes_in_use")
+            if isinstance(limit, (int, float)) and isinstance(used, (int, float)):
+                free = int(limit) - int(used)
+                headroom = free if headroom is None else min(headroom, free)
+        if headroom is not None:
+            self.metrics.set_gauge("device_mem_headroom_bytes", float(headroom))
+        return recorded
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="device-mem-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        # first sample immediately (a daemon's /metrics should show
+        # device gauges before the first interval elapses), then poll
+        while True:
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 - sampling must never kill serving
+                pass
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+
+def format_bytes(n: float) -> str:
+    """Human bytes for warmup prints and the CLI table (binary units)."""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
