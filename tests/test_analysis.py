@@ -104,6 +104,30 @@ def test_hostsync_allows_sink_boundary_and_untainted(tmp_path):
     assert fs == []
 
 
+def test_hostsync_drain_allowlist_is_scope_pinned(tmp_path):
+    """The async-ingest drain boundary (extract/base.py::drain_completed)
+    is allowlisted BY NAME — this pins that scope: the same blocking
+    fetch under any other name refires GC103, so a rename out of the
+    ``drain_*`` family cannot silently widen the allowlist."""
+    drain_body = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def {name}(handle):
+            # completion-queue drain: the ONE sync point per group
+            y = jnp.square(handle)
+            return np.asarray(y)
+        """
+    assert _check(tmp_path, drain_body.format(name="drain_completed"),
+                  prefix=HOT) == []
+    assert _check(tmp_path, drain_body.format(name="_drain_inflight"),
+                  prefix=HOT) == []
+    refire = _check(tmp_path, drain_body.format(name="pop_completed"),
+                    prefix=HOT)
+    assert _ids(refire) == ["GC103"]
+    assert "pop_completed" in refire[0].message
+
+
 def test_hostsync_waiver_silences(tmp_path):
     fs = _check(
         tmp_path,

@@ -170,6 +170,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "serve_latency": {"serve_warm_request_s": 0.5},
         "serve_scheduling": {"serve_sched_edf_miss_rate": 0.0},
         "ledger_overhead": {"ledger_overhead_us_per_video": 16.0},
+        "ingest_overlap": {"ingest_overlap_efficiency": 0.02},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -205,6 +206,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["serve_warm_request_s"] == 0.5
     assert final["extra"]["serve_sched_edf_miss_rate"] == 0.0
     assert final["extra"]["ledger_overhead_us_per_video"] == 16.0
+    assert final["extra"]["ingest_overlap_efficiency"] == 0.02
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -246,6 +248,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"serve_sched_edf_miss_rate": 0.0}
         if name == "ledger_overhead":  # AOT analysis micro-bench, CPU-pinned
             return {"ledger_overhead_us_per_video": 16.0}
+        if name == "ingest_overlap":  # loop-structure bench, CPU-pinned
+            return {"ingest_overlap_efficiency": 0.02}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

@@ -1,0 +1,550 @@
+"""Async device ingest (extract/ingest.py + the restructured
+_run_pipelined): completion-queue depth/ordering, fused-failure -> solo
+fallback with >2 groups in flight, donation-safe payload lifetime,
+timer-scheduled retry backoff, frame-delta gating parity and skip
+behavior, and the ingest heartbeat/metrics gauges.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig, sanity_check
+from video_features_tpu.extract import ingest
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.paths import video_path_of
+from video_features_tpu.io.video import stream_frames
+from video_features_tpu.ops.sampler import copy_forward, frame_delta_keep_mask
+from video_features_tpu.runtime import faults
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_fault_state():
+    yield
+    faults.install_injector(None)
+    from video_features_tpu.io.video import set_decode_timeout
+
+    set_decode_timeout(None)
+
+
+@pytest.fixture(scope="module")
+def toy_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    d = tmp_path_factory.mktemp("ingest_media")
+    return [
+        synth_video(str(d / f"v{i}.mp4"), n_frames=10, width=64, height=48, seed=i)
+        for i in range(6)
+    ]
+
+
+def _cfg(videos, tmp_path, **kw):
+    kw.setdefault("decode_workers", 1)
+    kw.setdefault("retry_backoff", 0.01)
+    return ExtractionConfig(
+        allow_random_init=True,
+        video_paths=list(videos),
+        on_extraction="save_numpy",
+        output_path=str(tmp_path / "out"),
+        tmp_path=str(tmp_path / "tmp"),
+        cpu=True,
+        **kw,
+    )
+
+
+class ToyExtractor(BaseExtractor):
+    feature_type = "toy"
+
+    def _build(self, device):
+        return {"device": device}
+
+    def prepare(self, path_entry):
+        vals = [
+            float(frame.mean())
+            for frame, _ in stream_frames(video_path_of(path_entry))
+        ]
+        return np.asarray(vals, dtype=np.float32)
+
+    def extract_prepared(self, device, state, path_entry, payload):
+        return {
+            "toy": np.asarray(payload).reshape(-1, 1),
+            "fps": 25.0,
+            "timestamps_ms": np.arange(len(payload), dtype=np.float64),
+        }
+
+
+class _Handle:
+    """A dispatch handle with controllable device-side readiness: the
+    loop's non-blocking drain must treat ready=False as still-computing
+    (never popping it early) and ready=True as drainable."""
+
+    def __init__(self, value, ready=False):
+        self.value = value
+        self._ready = ready
+
+    def is_ready(self):
+        return self._ready
+
+
+class ToyAggDeep(ToyExtractor):
+    """Aggregation toy whose handles report not-ready until fetched,
+    so the completion queue genuinely FILLS to --inflight_groups (a
+    real jax handle on CPU completes near-instantly and would be
+    opportunistically drained at depth 1)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.events = []  # ("dispatch"|"fetch", [video names...])
+        self.max_inflight = 0
+        self._open = 0
+
+    def agg_key(self, payload):
+        return np.asarray(payload).shape
+
+    def dispatch_group(self, device, state, entries, payloads):
+        self._open += 1
+        self.max_inflight = max(self.max_inflight, self._open)
+        self.events.append(("dispatch", [str(e) for e in entries]))
+        dicts = [
+            ToyExtractor.extract_prepared(self, device, state, e, p)
+            for e, p in zip(entries, payloads)
+        ]
+        return _Handle(dicts, ready=False)
+
+    def fetch_group(self, handle):
+        self._open -= 1
+        self.events.append(("fetch", [len(handle.value)]))
+        return handle.value
+
+
+# --- pure units --------------------------------------------------------------
+
+
+def test_completion_queue_fifo_and_head_readiness():
+    q = ingest.CompletionQueue(3)
+    assert len(q) == 0 and not q and not q.head_ready()
+    h1, h2 = _Handle(1), _Handle(2)
+    q.push(["a"], h1, False, None)
+    q.push(["b"], h2, False, None)
+    assert len(q) == 2 and not q.full
+    assert not q.head_ready()  # h1 still computing
+    h2._ready = True  # a LATER entry finishing never unblocks the head
+    assert not q.head_ready()
+    h1._ready = True
+    assert q.head_ready()
+    assert q.pop()[0] == ["a"]  # FIFO
+    assert q.pop()[0] == ["b"]
+    q2 = ingest.CompletionQueue(1)
+    q2.push(["x"], _Handle(0), False, None)
+    assert q2.full
+
+
+def test_handle_ready_mixed_leaves():
+    # host-only handles (numpy, floats, nested tuples) are always ready
+    assert ingest.handle_ready((np.zeros(3), 1.0, [("meta", 2)]))
+    # one not-ready probe anywhere in the tree blocks the whole handle
+    assert not ingest.handle_ready((np.zeros(3), _Handle(0)))
+    assert ingest.handle_ready((np.zeros(3), _Handle(0, ready=True)))
+
+
+def test_requeue_timers_schedule_and_pending():
+    timers = ingest.RequeueTimers()
+    fired = []
+    timers.schedule(0.0, lambda: fired.append("now"))  # zero delay: inline
+    assert fired == ["now"] and timers.pending() == 0
+    timers.schedule(0.05, lambda: fired.append("later"))
+    assert timers.pending() == 1
+    deadline = time.monotonic() + 2.0
+    while timers.pending() and time.monotonic() < deadline:
+        timers.wait_any(0.05)
+    assert timers.pending() == 0
+    # pending() hit zero only AFTER the fire ran (the drain-loop contract)
+    assert fired == ["now", "later"]
+
+
+def test_frame_delta_keep_mask_semantics():
+    a = np.zeros((4, 4, 3), dtype=np.uint8)
+    b = np.full((4, 4, 3), 200, dtype=np.uint8)
+    # static: only frame 0 kept
+    assert frame_delta_keep_mask([a, a, a, a], 3.0).tolist() == [
+        True, False, False, False,
+    ]
+    # threshold 0 keeps everything (strictly-below skip rule)
+    assert frame_delta_keep_mask([a, a, a], 0.0).all()
+    # comparison is against the last KEPT frame: a slow drift of +2/frame
+    # under threshold 5 re-keys once the accumulated delta crosses it
+    drift = [np.full((4, 4, 3), v, dtype=np.uint8) for v in (0, 2, 4, 6, 8)]
+    assert frame_delta_keep_mask(drift, 5.0).tolist() == [
+        True, False, False, True, False,
+    ]
+    # a hard cut is always kept
+    assert frame_delta_keep_mask([a, b, a], 3.0).all()
+
+
+def test_copy_forward_expands_kept_rows():
+    rows = np.array([[1.0], [2.0]])
+    keep = np.array([True, False, True, False, False])
+    np.testing.assert_array_equal(
+        copy_forward(rows, keep), np.array([[1.0], [1.0], [2.0], [2.0], [2.0]])
+    )
+    # all-kept is the identity (the threshold-0 parity contract)
+    full = np.arange(6, dtype=np.float64).reshape(3, 2)
+    np.testing.assert_array_equal(copy_forward(full, np.ones(3, dtype=bool)), full)
+
+
+def test_config_validates_ingest_knobs(toy_videos, tmp_path):
+    sanity_check(_cfg(toy_videos, tmp_path, inflight_groups=4))
+    with pytest.raises(ValueError, match="inflight_groups"):
+        sanity_check(_cfg(toy_videos, tmp_path, inflight_groups=0))
+    with pytest.raises(ValueError, match="frame_delta_threshold"):
+        sanity_check(_cfg(toy_videos, tmp_path, frame_delta_threshold=-1.0))
+    # the gate is only sound for frame-level (CLIP-family) extractors
+    sanity_check(_cfg(toy_videos, tmp_path, frame_delta_threshold=2.0))
+    with pytest.raises(ValueError, match="frame-level"):
+        sanity_check(
+            _cfg(toy_videos, tmp_path, feature_type="resnet50",
+                 frame_delta_threshold=2.0)
+        )
+
+
+# --- completion-queue drain through the real loop ----------------------------
+
+
+def test_deep_queue_fills_and_drains_fifo(toy_videos, tmp_path):
+    """With not-ready handles and --inflight_groups 3, the loop must hold
+    three dispatched groups in flight before blocking on the OLDEST
+    (FIFO), and every video still sinks exactly once."""
+    cfg = _cfg(toy_videos, tmp_path, video_batch=2, inflight_groups=3)
+    ex = ToyAggDeep(cfg, external_call=True)
+    results = ex()
+    assert len(results) == 6  # 3 groups of 2
+    assert ex.max_inflight == 3
+    # drains are FIFO: the i-th fetch closes the i-th dispatch
+    dispatches = [e for e in ex.events if e[0] == "dispatch"]
+    fetches = [e for e in ex.events if e[0] == "fetch"]
+    assert len(dispatches) == 3 and len(fetches) == 3
+    inflight = ex.telemetry.metrics.gauge("queue_depth.inflight")
+    assert inflight == 0  # fully drained at exit
+
+
+def test_inflight_groups_one_is_lockstep(toy_videos, tmp_path):
+    cfg = _cfg(toy_videos, tmp_path, video_batch=2, inflight_groups=1)
+    ex = ToyAggDeep(cfg, external_call=True)
+    results = ex()
+    assert len(results) == 6
+    assert ex.max_inflight == 1  # dispatch-then-fetch, never two in flight
+
+
+def test_fused_fetch_failure_solo_fallback_deep_queue(toy_videos, tmp_path, capsys):
+    """A fused fetch that dies while THREE groups are in flight recovers
+    exactly its own members through the solo path; the other in-flight
+    groups drain normally."""
+    cfg = _cfg(toy_videos, tmp_path, video_batch=2, inflight_groups=3)
+    ex = ToyAggDeep(cfg, external_call=True)
+    real = ToyAggDeep.fetch_group
+    calls = {"n": 0}
+
+    def flaky(self, handle):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected fused-fetch failure")
+        return real(self, handle)
+
+    ex.fetch_group = flaky.__get__(ex)
+    results = ex()
+    assert len(results) == 6
+    assert "falling back to per-video dispatch" in capsys.readouterr().out
+    assert ex.progress.n == 6
+    # outputs are identical to a clean solo run
+    solo = ToyExtractor(_cfg(toy_videos, tmp_path), external_call=True)()
+    for s, f in zip(solo, results):
+        np.testing.assert_array_equal(f["toy"], s["toy"])
+
+
+class DonatingToy(ToyAggDeep):
+    """Simulates the donation contract: transfer_group stages host
+    payloads into jax device buffers, dispatch consumes them and then
+    DELETES the staged buffers (what donate_argnums does on TPU). The
+    solo fallback must still succeed afterwards — from the HOST
+    payloads the completion queue kept resident."""
+
+    def transfer_group(self, device, state, entries, payloads):
+        import jax
+
+        staged = [jax.device_put(np.asarray(p)) for p in payloads]
+        return ingest.StagedGroup(tuple(staged), [str(e) for e in entries])
+
+    def dispatch_group(self, device, state, entries, payloads):
+        assert isinstance(payloads, ingest.StagedGroup)
+        self._open += 1
+        self.max_inflight = max(self.max_inflight, self._open)
+        dicts = [
+            {
+                "toy": np.asarray(arr).reshape(-1, 1),
+                "fps": 25.0,
+                "timestamps_ms": np.arange(np.asarray(arr).size, dtype=np.float64),
+            }
+            for arr in payloads.arrays
+        ]
+        for arr in payloads.arrays:  # donation: the staged buffers die here
+            arr.delete()
+        return _Handle(dicts, ready=False)
+
+
+def test_donation_safe_payload_lifetime(toy_videos, tmp_path, capsys):
+    """Staged device buffers are donated (deleted) at dispatch; a fused
+    fetch failure must still recover every member solo, proving the
+    HOST payloads stayed alive in the completion queue for the whole
+    in-flight window."""
+    cfg = _cfg(toy_videos, tmp_path, video_batch=2, inflight_groups=3)
+    ex = DonatingToy(cfg, external_call=True)
+    real = DonatingToy.fetch_group
+    calls = {"n": 0}
+
+    def flaky(self, handle):
+        calls["n"] += 1
+        if calls["n"] == 2:  # fail the MIDDLE group of three in flight
+            raise RuntimeError("injected fused-fetch failure")
+        return real(self, handle)
+
+    ex.fetch_group = flaky.__get__(ex)
+    results = ex()
+    assert len(results) == 6
+    assert "falling back to per-video dispatch" in capsys.readouterr().out
+    solo = ToyExtractor(_cfg(toy_videos, tmp_path), external_call=True)()
+    for s, f in zip(solo, results):
+        np.testing.assert_array_equal(f["toy"], s["toy"])
+
+
+# --- fault / resume parity at inflight_groups > 2 ----------------------------
+
+
+def test_faults_and_resume_parity_at_deep_inflight(toy_videos, tmp_path):
+    """The PR-3 contracts survive the restructure at --inflight_groups 4:
+    an injected fused-dispatch OOM falls back per-video, the manifest
+    records it, and a --resume pass over the same output dir skips the
+    completed videos — outputs byte-identical to a clean shallow run."""
+    import glob
+    import os
+
+    cfg = _cfg(
+        toy_videos, tmp_path, video_batch=2, inflight_groups=4,
+        fault_inject=["dispatch:oom:2"],
+    )
+    ex = ToyAggDeep(cfg, external_call=False)
+    ex()
+    outs = sorted(glob.glob(os.path.join(cfg.output_path, "**", "*toy.npy"),
+                            recursive=True))
+    assert len(outs) == 6  # every video delivered despite the OOM groups
+    s = faults.finalize_run(cfg.output_path)
+    assert s is not None and s["failed"] == 0
+
+    # resume over the same dir: everything skips
+    cfg2 = _cfg(
+        toy_videos, tmp_path, video_batch=2, inflight_groups=4, resume=True,
+    )
+    ex2 = ToyAggDeep(cfg2, external_call=False)
+    ex2()
+    assert ex2.events == []  # nothing dispatched: resume skipped all
+
+    # values match a clean lockstep run
+    clean_dir = tmp_path / "clean"
+    cfg3 = _cfg(toy_videos, clean_dir, video_batch=2, inflight_groups=2)
+    ToyAggDeep(cfg3, external_call=False)()
+    for out in outs:
+        clean = os.path.join(
+            cfg3.output_path, os.path.relpath(out, cfg.output_path)
+        )
+        np.testing.assert_array_equal(np.load(out), np.load(clean))
+
+
+# --- timer-scheduled backoff -------------------------------------------------
+
+
+class FlakyPrep(ToyExtractor):
+    """Every video's FIRST prepare fails transiently (OSError), so every
+    video takes exactly one backoff delay before its retry."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._failed = set()
+        self._lock = threading.Lock()
+
+    def prepare(self, path_entry):
+        key = str(path_entry)
+        with self._lock:
+            first = key not in self._failed
+            self._failed.add(key)
+        if first:
+            raise OSError("io flake")
+        return super().prepare(path_entry)
+
+
+def test_backoff_timers_do_not_serialize_on_the_decode_worker(
+    toy_videos, tmp_path
+):
+    """With ONE decode worker and every video retrying once, the old
+    sleep-in-worker backoff would serialize the delays (>= sum); the
+    timer scheduler overlaps them (~ max). The deterministic jitter
+    makes both bounds computable exactly."""
+    base = 1.0
+    cfg = _cfg(toy_videos, tmp_path, decode_workers=1, retry_backoff=base,
+               retries=2)
+    delays = [faults.backoff_delay(1, base, str(v)) for v in toy_videos]
+    ex = FlakyPrep(cfg, external_call=True)
+    t0 = time.monotonic()
+    results = ex()
+    wall = time.monotonic() - t0
+    assert len(results) == 6
+    # decisive margin: six serialized delays are >= sum(delays) (>= 3s
+    # at jitter floor); overlapped timers finish in ~max(delays) (< 1s)
+    assert wall < sum(delays), (
+        f"wall {wall:.2f}s suggests backoff serialized on the decode "
+        f"worker (sum of delays = {sum(delays):.2f}s)"
+    )
+    assert int(ex.telemetry.metrics.counter("retries")) == 6
+
+
+# --- heartbeat / metrics -----------------------------------------------------
+
+
+def test_heartbeat_line_includes_ingest_depths(toy_videos, tmp_path):
+    cfg = _cfg(toy_videos, tmp_path, video_batch=2, inflight_groups=3)
+    ex = ToyAggDeep(cfg, external_call=True)
+    ex()
+    ex.telemetry.metrics.set_gauge("queue_depth.inflight", 2)
+    ex.telemetry.metrics.set_gauge("queue_depth.prepared", 1)
+    line = ex.telemetry.heartbeat_line()
+    assert "inflight 2" in line and "prepared 1" in line
+
+
+def test_metrics_exposition_ingest_families(toy_videos, tmp_path):
+    from video_features_tpu.telemetry.exposition import (
+        families_from_snapshot,
+        render_families,
+    )
+
+    cfg = _cfg(toy_videos, tmp_path, video_batch=2)
+    ex = ToyAggDeep(cfg, external_call=True)
+    ex()
+    ex.telemetry.metrics.inc("windows_skipped", 7)
+    text = render_families(
+        families_from_snapshot(ex.telemetry.metrics.snapshot())
+    )
+    assert "vft_windows_skipped_total 7" in text
+    assert 'vft_queue_depth{queue="inflight"}' in text
+    assert 'vft_queue_depth{queue="prepared"}' in text
+
+
+# --- frame-delta gating on the real CLIP path --------------------------------
+
+
+@pytest.fixture(scope="module")
+def gating_videos(tmp_path_factory):
+    """One static clip (the near-duplicate corpus) + one moving clip."""
+    from video_features_tpu.utils.synth import synth_video
+
+    d = tmp_path_factory.mktemp("gating_media")
+    return [
+        synth_video(str(d / "static.mp4"), n_frames=16, width=128, height=96,
+                    seed=0, static=True),
+        synth_video(str(d / "moving.mp4"), n_frames=16, width=128, height=96,
+                    seed=1),
+    ]
+
+
+def _clip_cfg(paths, tmp_path, **kw):
+    return ExtractionConfig(
+        allow_random_init=True,
+        feature_type="CLIP-ViT-B/32",
+        video_paths=list(paths),
+        extract_method="uni_4",
+        tmp_path=str(tmp_path / "tmp"),
+        output_path=str(tmp_path / "out"),
+        cpu=True,
+        **kw,
+    )
+
+
+def test_frame_delta_threshold_zero_is_bit_identical(gating_videos, tmp_path):
+    """The pinned parity contract: --frame_delta_threshold 0 runs the
+    gating code path (mask computed, all frames kept) and must produce
+    byte-identical features to the gating-off default."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    off = ExtractCLIP(_clip_cfg(gating_videos, tmp_path), external_call=True)()
+    zero = ExtractCLIP(
+        _clip_cfg(gating_videos, tmp_path, frame_delta_threshold=0.0),
+        external_call=True,
+    )()
+    assert len(off) == len(zero) == 2
+    for a, b in zip(off, zero):
+        np.testing.assert_array_equal(b["CLIP-ViT-B/32"], a["CLIP-ViT-B/32"])
+        np.testing.assert_array_equal(b["timestamps_ms"], a["timestamps_ms"])
+
+
+def test_frame_delta_gating_skips_static_scene(gating_videos, tmp_path):
+    """On the static clip the gate must skip >0 frames, count them in
+    the windows_skipped metric + delta_gated manifest note, and fill
+    the skipped rows by copy-forward — keeping the (T, 512) shape
+    contract over the FULL sampling grid."""
+    from video_features_tpu.models.clip.extract_clip import ExtractCLIP
+
+    ungated = ExtractCLIP(
+        _clip_cfg(gating_videos, tmp_path / "ref"), external_call=True
+    )()
+    # external_call=False + save_numpy: the one combination that roots a
+    # real RunManifest (base.py gates it off for external/print runs), so
+    # the delta_gated note lands on disk. Features come back off the .npy
+    # sink output instead of a return value.
+    ex = ExtractCLIP(
+        _clip_cfg(gating_videos, tmp_path, frame_delta_threshold=2.0,
+                  on_extraction="save_numpy"),
+        external_call=False,
+    )
+    ex()
+    skipped = int(ex.telemetry.metrics.counter("windows_skipped"))
+    assert skipped > 0  # static corpus: the gate fired
+
+    import glob
+    import json
+    import os
+
+    def _load(stem, key):
+        return np.load(
+            os.path.join(
+                ex.output_path, f"{stem}_{key.replace('/', '-')}.npy"
+            )
+        )
+
+    static_feats = _load("static", "CLIP-ViT-B/32")
+    assert static_feats.shape == ungated[0]["CLIP-ViT-B/32"].shape == (4, 512)
+    # every skipped row equals its copy-forward source; frame 0 is kept
+    # and static frames collapse onto it
+    np.testing.assert_array_equal(
+        static_feats, np.broadcast_to(static_feats[:1], static_feats.shape)
+    )
+    # the kept frame's feature matches the ungated run's frame 0
+    np.testing.assert_allclose(
+        static_feats[0], ungated[0]["CLIP-ViT-B/32"][0], atol=2e-5, rtol=1e-5
+    )
+    # the moving clip is untouched by the gate (scene drifts > threshold)
+    np.testing.assert_allclose(
+        _load("moving", "CLIP-ViT-B/32"),
+        ungated[1]["CLIP-ViT-B/32"],
+        atol=2e-5, rtol=1e-5,
+    )
+    # the manifest carries the per-video note
+    rows = []
+    for p in glob.glob(
+        os.path.join(ex.config.output_path, "_manifest", "*.jsonl")
+    ):
+        with open(p, encoding="utf-8") as f:
+            rows += [json.loads(line) for line in f if line.strip()]
+    events = [r for r in rows if r.get("event") == "delta_gated"]
+    assert events and any("static" in str(e.get("video")) for e in events)
+    assert all(e["skipped"] > 0 and e["total"] == 4 for e in events)

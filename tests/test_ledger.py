@@ -421,6 +421,25 @@ def test_compare_tolerates_sparse_bases_and_missing_keys():
     assert out["regressed"] == []
 
 
+def test_compare_host_pipeline_subtree_is_informational():
+    """Host-capability sizing numbers (host_pipeline.*) never hard-gate:
+    rounds run on heterogeneous containers, so a slower host must not
+    read as a code regression — the same leaf OUTSIDE the subtree still
+    gates (the e2e vps keys carry the code-regression signal)."""
+    import bench
+
+    bases = [_bench_doc(host_pipeline={"host_decode_cv2_fps": 2000.0},
+                        host_decode_cv2_fps=2000.0)]
+    out = bench.compare_bench(
+        _bench_doc(host_pipeline={"host_decode_cv2_fps": 1000.0},
+                   host_decode_cv2_fps=1000.0),
+        bases,
+    )
+    assert out["keys"]["host_pipeline.host_decode_cv2_fps"]["status"] == "info"
+    assert "host_pipeline.host_decode_cv2_fps" not in out["regressed"]
+    assert "host_decode_cv2_fps" in out["regressed"]
+
+
 def test_compare_main_rc_contract(tmp_path):
     import bench
 

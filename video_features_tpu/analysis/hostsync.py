@@ -56,9 +56,16 @@ RULES = {
     ),
 }
 
-# the sink/fetch boundary: these functions' JOB is the one blocking fetch
-# per video (extract/base.py pipelined loop contract)
-ALLOWED_NAME_PREFIXES = ("fetch_", "_fetch")
+# the sink/fetch/drain boundary: these functions' JOB is the blocking
+# fetch side of the pipeline. ``fetch_*`` are the extractor hooks
+# (fetch_group/fetch_dispatched), ``drain_*`` is the pipelined loop's
+# completion-queue drain (extract/base.py::drain_completed — the ONE
+# place dispatched handles become host numpy since the async-ingest
+# restructure), and "sink" covers the result writers. Anything else
+# that forces a device->host sync in a hot module is a finding — the
+# scope-pin test in tests/test_analysis.py proves a rename out of this
+# list would refire.
+ALLOWED_NAME_PREFIXES = ("fetch_", "_fetch", "drain_", "_drain")
 ALLOWED_NAME_SUBSTRINGS = ("sink",)
 
 

@@ -181,6 +181,23 @@ class ExtractionConfig:
     # Requires decode_workers >= 1 (the async pipeline hosts the
     # grouping); show_pred keeps per-video dispatch.
     video_batch: int = 1
+    # Depth of the async-ingest completion queue (extract/ingest.py):
+    # how many dispatched groups/videos may stay in flight on the
+    # device before the loop blocks on the oldest one's fetch. 2 is
+    # the classic double-buffer (and today's behavior): group N+1's
+    # H2D/compute is enqueued while group N finishes. Raising it deepens
+    # the pipeline (more HBM pinned by in-flight payloads) for
+    # high-latency transports; 1 degenerates to lockstep
+    # dispatch-then-fetch.
+    inflight_groups: int = 2
+    # Frame-delta gating (--preprocess host or device, CLIP family
+    # only): mean |uint8 delta| below this threshold vs the last KEPT
+    # frame marks a sampled frame near-duplicate — it is skipped BEFORE
+    # H2D and its feature row is filled by copy-forward at fetch time
+    # (ops/sampler.py). None = off (the parity default); 0 keeps every
+    # frame (the skip rule is strictly-below), so `0` is bit-identical
+    # to off. FASTER (PAPERS.md) motivates the redundancy skip.
+    frame_delta_threshold: Optional[float] = None
     # Context parallelism (--sharding mesh only): shard the transformer's
     # token axis over the mesh 'data' axis and run ring attention — KV
     # shards rotate chip-to-chip over ICI (parallel/ring_attention.py) —
@@ -347,6 +364,25 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
             ">= 1 (aggregation groups prepared videos, and only "
             "_run_pipelined prepares ahead)"
         )
+    if cfg.inflight_groups < 1:
+        raise ValueError(
+            f"inflight_groups must be >= 1, got {cfg.inflight_groups}"
+        )
+    if cfg.frame_delta_threshold is not None:
+        if cfg.frame_delta_threshold < 0:
+            raise ValueError(
+                "frame_delta_threshold must be >= 0, got "
+                f"{cfg.frame_delta_threshold}"
+            )
+        if cfg.feature_type not in CLIP_FEATURE_TYPES:
+            supported = ", ".join(CLIP_FEATURE_TYPES)
+            raise ValueError(
+                "--frame_delta_threshold gates per-frame features with "
+                "copy-forward fill, which is only sound for the "
+                f"frame-level extractors: {supported} "
+                f"(got {cfg.feature_type!r}; windowed/flow models mix "
+                "frames across time)"
+            )
     if cfg.attn not in ("fused", "flash", "blockwise"):
         raise ValueError(f"unknown attn core: {cfg.attn}")
     if cfg.conv3d_impl not in ("auto", "direct", "decomposed"):
@@ -545,6 +581,17 @@ def build_arg_parser(feature_required: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--video_batch", type=int, default=1,
                    help="aggregate up to N videos' prepared batches into "
                         "one device dispatch (CLIP/ResNet/R21D); 1 = off")
+    p.add_argument("--inflight_groups", type=int, default=2,
+                   help="async-ingest completion-queue depth: dispatched "
+                        "groups that may stay in flight before the loop "
+                        "blocks on the oldest fetch (2 = the classic "
+                        "double-buffer; 1 = lockstep dispatch-then-fetch)")
+    p.add_argument("--frame_delta_threshold", type=float, default=None,
+                   help="skip sampled frames whose mean |uint8 delta| vs "
+                        "the last kept frame is strictly below this, "
+                        "filling their feature rows by copy-forward "
+                        "(CLIP family only; default off, 0 is "
+                        "bit-identical to off)")
     p.add_argument("--preprocess", default="host", choices=["host", "device"],
                    help="where the resize/crop/normalize chain runs: "
                         "'host' (reference-exact PIL, the default) or "

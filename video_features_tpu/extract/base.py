@@ -704,22 +704,32 @@ class BaseExtractor:
         whose payloads can be large return ``agg_key=None`` above a size
         cap, which routes that video through the individual path.
 
+        Dispatched work lands in a ``--inflight_groups``-deep
+        CompletionQueue (extract/ingest.py): the drain blocks on the
+        oldest entry only when the window is full, and opportunistically
+        sinks any head whose device buffers already report ready — so
+        group N+1's H2D (the dedicated ``transfer_group`` stage, timed
+        under the ``h2d`` span) issues while group N computes, instead
+        of the old lockstep dispatch-then-fetch turn-taking.
+
         Failure policy (runtime/faults.py; docs/robustness.md): every
         per-video failure goes through ``_on_failure`` — transient ones
-        re-enter ``pending`` as a fresh prepare future after backoff
-        (``requeue``), compile failures under --preprocess device degrade
-        to the host chain, fused-group failures fall back to per-video
-        dispatch, and everything terminal lands in the run manifest."""
+        re-enter ``pending`` as a fresh prepare future after a
+        timer-scheduled backoff (``requeue``; the timer, not a decode
+        worker, owns the wait), compile failures under --preprocess
+        device degrade to the host chain, fused-group failures fall
+        back to per-video dispatch, and everything terminal lands in
+        the run manifest."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
+
+        from video_features_tpu.extract import ingest
 
         workers = max(1, int(self.config.decode_workers))
         depth = workers + 1  # prepared-and-waiting beyond the one consumed
         wid = str(device)
 
-        def prep(entry, delay: float = 0.0, attempt: int = 1):
-            if delay > 0:
-                time.sleep(delay)  # backoff burns a decode worker, not the device loop
+        def prep(entry, attempt: int = 1):
             self._mark_start(entry)
             with self.telemetry.span(
                 "prepare", video=self._video_key(entry),
@@ -738,31 +748,40 @@ class BaseExtractor:
                     self._drain_decode_warnings(entry)
 
         pending: deque = deque()  # (pos, idx, attempt, fut)
-        # device pipeline (extractors with the dispatch/fetch split): one
-        # video's transfer+compute stays in flight while the previous
-        # video's results are fetched/sunk
+        # device pipeline (extractors with the dispatch/fetch split): up
+        # to --inflight_groups dispatched groups/videos stay in flight
+        # while earlier results are fetched/sunk
         split = self._supports_device_pipeline()
         agg = self._aggregation_enabled()
         group_size = max(int(self.config.video_batch or 1), 1)
         groups: Dict[Any, list] = {}  # agg_key -> [(pos, idx, attempt, entry, payload)]
-        # ([(pos, idx, attempt, entry), ...], handle, grouped,
-        # payloads-or-None); grouped entries keep their payloads
-        # host-resident until fetch succeeds so a fused failure can fall
-        # back to the solo path (inflight depth is <=2, so at most two
-        # groups' payloads stay pinned)
-        inflight: deque = deque()
+        # CompletionQueue entries: ([(pos, idx, attempt, entry), ...],
+        # handle, grouped, payloads-or-None). Grouped entries keep their
+        # HOST payloads resident until their drain succeeds, so a fused
+        # failure can fall back to the solo path even when the staged
+        # device copies were donated to the fused jit entry (at most
+        # --inflight_groups groups' payloads stay pinned).
+        inflight = ingest.CompletionQueue(
+            max(int(getattr(self.config, "inflight_groups", 2) or 2), 1)
+        )
+        timers = ingest.RequeueTimers()
 
         def requeue(pos, idx, attempt):
             """Retry closure for _on_failure: resubmit a prepare future
-            (delayed by backoff) at attempt+1. Retries during the final
-            drain re-enter ``pending``, which the outer drain loop below
-            keeps consuming."""
+            at attempt+1 once the backoff timer fires (the timer owns
+            the wait — no decode worker sleeps). Retries during the
+            final drain re-enter ``pending``, which the outer drain
+            loop below keeps consuming; it also waits on
+            ``timers.pending()`` so an armed retry cannot be stranded."""
 
             def do(delay: float) -> None:
-                pending.append(
-                    (pos, idx, attempt + 1,
-                     pool.submit(prep, self.path_list[idx], delay, attempt + 1))
-                )
+                def fire() -> None:
+                    pending.append(
+                        (pos, idx, attempt + 1,
+                         pool.submit(prep, self.path_list[idx], attempt + 1))
+                    )
+
+                timers.schedule(delay, fire)
 
             return do
 
@@ -833,8 +852,19 @@ class BaseExtractor:
             for pos, idx, attempt, e, p in items:
                 run_solo(pos, idx, attempt, e, p, inject=False)
 
-        def fetch_one():
-            slots, handle, grouped, payloads = inflight.popleft()
+        def drain_completed(only_ready: bool = False) -> bool:
+            """Drain ONE entry from the completion queue: fetch its
+            device results and sink them (the allowlisted GC10x/GC312
+            host-sync boundary — this drain is where device values
+            legitimately become host numpy). ``only_ready=True`` pops
+            only if the head's device buffers already report complete
+            (non-blocking probe), so the loop can sink finished work
+            without stalling behind still-computing groups. Returns
+            True when an entry was drained."""
+            if only_ready and not inflight.head_ready():
+                return False
+            slots, handle, grouped, payloads = inflight.pop()
+            self.telemetry.metrics.set_gauge("queue_depth.inflight", len(inflight))
             if grouped:
                 fused_err = None
                 try:
@@ -860,10 +890,10 @@ class BaseExtractor:
                         "fetch",
                         fused_err,
                     )
-                    return
+                    return True
                 for (pos, idx, att, e), d in zip(slots, dicts):
                     sink_one(pos, idx, att, e, d)
-                return
+                return True
             pos, idx, attempt, entry = slots[0]
             try:
                 with self.telemetry.span(
@@ -883,8 +913,18 @@ class BaseExtractor:
                         device, state, pos, attempt, entry, results
                     ),
                 )
-                return
+                return True
             sink_one(pos, idx, attempt, entry, feats_dict)
+            return True
+
+        def drain_to_capacity():
+            """Post-dispatch drain policy: block on the oldest entry
+            while the completion window is over capacity, then sink
+            whatever else already finished without blocking."""
+            while len(inflight) >= inflight.depth:
+                drain_completed()
+            while drain_completed(only_ready=True):
+                pass
 
         def dispatch_group_now(items):  # items: [(pos, idx, attempt, entry, payload)]
             entries = [e for _, _, _, e, _ in items]
@@ -895,24 +935,36 @@ class BaseExtractor:
                 # one device program); the OOM spec's split-then-recover
                 # path is exactly this: fused raise -> solo_fallback
                 faults.fire("dispatch")
+                # dedicated transfer stage: assemble + device_put the
+                # fused group under the h2d span (extractors without a
+                # transfer_group return None and keep placement inside
+                # dispatch_group, as before)
                 with self.telemetry.span(
-                    "dispatch", worker=wid, group_size=len(items),
+                    "h2d", worker=wid, group_size=len(items),
                 ):
                     for p in payloads:
                         self.telemetry.count_h2d(p)
-                    handle = self.dispatch_group(device, state, entries, payloads)
+                    staged = self.transfer_group(device, state, entries, payloads)
+                with self.telemetry.span(
+                    "dispatch", worker=wid, group_size=len(items),
+                ):
+                    handle = self.dispatch_group(
+                        device, state, entries,
+                        staged if staged is not None else payloads,
+                    )
             except KeyboardInterrupt:
                 raise
-            except Exception:  # noqa: BLE001 - fused dispatch fails together
+            except Exception:  # noqa: BLE001 - fused transfer/dispatch fails together
                 fused_err = traceback.format_exc()
             if fused_err is not None:
                 solo_fallback(items, "dispatch", fused_err)
                 return
-            inflight.append(
-                ([(pos, idx, att, e) for pos, idx, att, e, _ in items], handle, True, payloads)
+            inflight.push(
+                [(pos, idx, att, e) for pos, idx, att, e, _ in items],
+                handle, True, payloads,
             )
-            if len(inflight) > 1:
-                fetch_one()
+            self.telemetry.metrics.set_gauge("queue_depth.inflight", len(inflight))
+            drain_to_capacity()
 
         def dispatch_single(pos, idx, attempt, entry, payload):
             if split:
@@ -923,13 +975,14 @@ class BaseExtractor:
                         attempt=attempt, worker=wid,
                     ):
                         self.telemetry.count_h2d(payload)
-                        inflight.append(
-                            (
-                                [(pos, idx, attempt, entry)],
-                                self.dispatch_prepared(device, state, entry, payload),
-                                False,
-                                None,
-                            )
+                        inflight.push(
+                            [(pos, idx, attempt, entry)],
+                            self.dispatch_prepared(device, state, entry, payload),
+                            False,
+                            None,
+                        )
+                        self.telemetry.metrics.set_gauge(
+                            "queue_depth.inflight", len(inflight)
                         )
                 except KeyboardInterrupt:
                     raise
@@ -943,8 +996,7 @@ class BaseExtractor:
                             device, state, pos, attempt, entry, results
                         ),
                     )
-                if len(inflight) > 1:
-                    fetch_one()
+                drain_to_capacity()
                 return
 
             run_solo(pos, idx, attempt, entry, payload)
@@ -957,10 +1009,14 @@ class BaseExtractor:
             metrics = self.telemetry.metrics
             metrics.set_gauge("queue_depth.pending", len(pending))
             metrics.set_gauge("queue_depth.inflight", len(inflight))
-            if agg:
-                metrics.set_gauge(
-                    "queue_depth.group_buffers", sum(len(b) for b in groups.values())
-                )
+            # 'prepared' = host-resident payloads waiting to dispatch
+            # (the --video_batch group buffers); exposition renders it
+            # as vft_queue_depth{queue="prepared"} and the heartbeat
+            # line carries it next to 'inflight'
+            metrics.set_gauge(
+                "queue_depth.prepared",
+                sum(len(b) for b in groups.values()) if agg else 0,
+            )
             entry = self.path_list[idx]
             try:
                 payload = fut.result()
@@ -998,10 +1054,12 @@ class BaseExtractor:
                 if len(pending) > depth:
                     consume_one()
             # retries re-enter `pending` from any of the drains below
-            # (consume/dispatch/fetch/sink), so the drain is ONE outer
-            # loop: separate sequential drains would strand a video
-            # requeued after its phase's drain had already passed
-            while pending or groups or inflight:
+            # (consume/dispatch/fetch/sink — possibly via a backoff
+            # timer still armed), so the drain is ONE outer loop:
+            # separate sequential drains would strand a video requeued
+            # after its phase's drain had already passed, and ignoring
+            # timers.pending() would exit with a retry still scheduled
+            while pending or groups or inflight or timers.pending():
                 while pending:
                     consume_one()
                 for key in list(groups):  # flush partial groups (< N videos)
@@ -1009,7 +1067,11 @@ class BaseExtractor:
                     if buf:
                         dispatch_group_now(buf)
                 while inflight and not pending:
-                    fetch_one()
+                    drain_completed()
+                if not (pending or groups or inflight):
+                    # only armed backoff timers remain: park until one
+                    # fires (bounded poll, not a busy spin)
+                    timers.wait_any(0.05)
 
     def _probe_done_safe(self, entry) -> bool:
         try:
@@ -1079,6 +1141,35 @@ class BaseExtractor:
         """Blocking half of ``dispatch_group``: fetch once, slice per
         video, return the feats_dicts in ``entries`` order."""
         raise NotImplementedError
+
+    def transfer_group(self, device, state, entries, payloads):
+        """Optional dedicated H2D stage for the fused --video_batch
+        path: assemble the group's host arrays and issue the explicit
+        device_put NOW (timed under the pipelined loop's ``h2d`` span),
+        returning an ``ingest.StagedGroup`` that ``dispatch_group``
+        consumes without touching host memory again — so the next
+        group's transfer overlaps this group's compute, and fused jit
+        entries may donate the staged buffers (``donate_argnums``:
+        XLA reuses the uint8 ingest HBM in place). Return None (the
+        default) to keep placement inside ``dispatch_group``. The host
+        payloads stay resident in the completion queue either way, so
+        the solo fallback survives donation."""
+        return None
+
+    def _note_windows_skipped(self, path_entry, skipped: int, total: int) -> None:
+        """Frame-delta gating accounting (--frame_delta_threshold): the
+        skip count rides the metrics registry (exposition renders it as
+        ``vft_windows_skipped_total``) and the run manifest as a
+        ``delta_gated`` note, so a gated run is auditable per video."""
+        if skipped <= 0:
+            return
+        self.telemetry.metrics.inc("windows_skipped", skipped)
+        self.manifest.event(
+            "delta_gated",
+            video=self._video_key(path_entry),
+            skipped=skipped,
+            total=total,
+        )
 
     def _dispatch_rows_grouped(self, state, rows, chunk_rows):
         """Shared chunked re-dispatch for row-batched aggregation (ResNet

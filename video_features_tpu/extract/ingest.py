@@ -1,0 +1,181 @@
+"""Async device-ingest plumbing for the pipelined extraction loop.
+
+The restructured ``_run_pipelined`` (extract/base.py) composes three
+pieces from here:
+
+* ``CompletionQueue`` — the bounded window of dispatched-but-unfetched
+  device work (``--inflight_groups`` deep). XLA dispatch is async, so a
+  dispatched group is a *handle*; the loop pushes handles here and a
+  single drain function pops them — blocking on the oldest only when
+  the window is full, opportunistically sinking any head whose device
+  buffers are already complete (``jax.Array.is_ready`` is a
+  non-blocking readiness probe, not a sync).
+* ``RequeueTimers`` — transient-retry backoff scheduled on
+  ``threading.Timer`` instead of ``time.sleep`` on a decode worker, so
+  a retrying video never steals decode throughput from the healthy
+  ones. The outer drain loop waits on ``pending()`` so a run cannot
+  exit while a delayed requeue is still armed.
+* ``StagedGroup`` — the marker an extractor's ``transfer_group`` hook
+  returns: the fused group's arrays already assembled and device_put
+  (the dedicated H2D stage, timed under the ``h2d`` telemetry span),
+  so ``dispatch_group`` only enqueues compute. Because the staged
+  buffers are fresh per group, the fused jit entries can donate them
+  (``donate_argnums``) and XLA reuses the uint8 ingest HBM in place.
+
+Donation note: CPU (and some backends) cannot alias these buffers and
+jax warns "Some donated buffers were not usable" on first execution;
+``jit_donated`` filters exactly that message so CPU parity runs stay
+clean while TPU gets the in-place reuse.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+_DONATE_WARNING = "Some donated buffers were not usable"
+
+
+def jit_donated(fun: Callable, donate_argnums: Tuple[int, ...], **jit_kwargs):
+    """``jax.jit`` with ingest-buffer donation plus the CPU-backend
+    warning filtered (see module docstring). Donate only arguments that
+    are freshly placed per call — never arrays reused across calls
+    (e.g. ResNet's per-video resize taps)."""
+    import jax
+
+    warnings.filterwarnings("ignore", message=_DONATE_WARNING)
+    return jax.jit(fun, donate_argnums=donate_argnums, **jit_kwargs)
+
+
+def handle_ready(handle: Any) -> bool:
+    """Non-blocking completion probe for a dispatch handle: True when
+    every jax array reachable in it reports ``is_ready()`` (host-side
+    leaves — numpy arrays, floats, metadata tuples — are always ready).
+    Never fetches and never blocks, so it is safe in the hot loop."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(handle):
+        probe = getattr(leaf, "is_ready", None)
+        if callable(probe):
+            try:
+                if not probe():
+                    return False
+            except Exception:  # noqa: BLE001 - a deleted/poisoned buffer: let
+                # the drain path surface the real error at fetch time
+                return True
+    return True
+
+
+class StagedGroup:
+    """Output of an extractor's ``transfer_group``: the fused group's
+    device-resident arrays plus the per-video metas ``fetch_group``
+    needs to slice results apart. ``dispatch_group`` receives this in
+    place of the host payload list and must consume ``arrays`` exactly
+    once (they may be donated to the fused jit entry)."""
+
+    __slots__ = ("arrays", "metas")
+
+    def __init__(self, arrays: Tuple[Any, ...], metas: List[Any]):
+        self.arrays = arrays
+        self.metas = metas
+
+
+class CompletionQueue:
+    """FIFO of in-flight dispatched groups, ``depth`` entries deep.
+
+    Entries are ``(slots, handle, grouped, payloads)`` exactly as the
+    old inflight deque held them; ``payloads`` keeps the host arrays
+    resident until the entry drains so a fused failure can fall back to
+    the solo path even when the staged device copies were donated."""
+
+    def __init__(self, depth: int):
+        self.depth = max(int(depth), 1)
+        self._q: deque = deque()
+
+    def push(self, slots, handle, grouped, payloads) -> None:
+        self._q.append((slots, handle, grouped, payloads))
+
+    def pop(self):
+        return self._q.popleft()
+
+    def head_ready(self) -> bool:
+        """True when the oldest entry's device work is already complete
+        (drain order stays FIFO: only the head is probed)."""
+        if not self._q:
+            return False
+        return handle_ready(self._q[0][1])
+
+    @property
+    def full(self) -> bool:
+        return len(self._q) >= self.depth
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __bool__(self) -> bool:
+        return bool(self._q)
+
+
+class RequeueTimers:
+    """Backoff scheduler for transient-retry requeues.
+
+    ``schedule(delay, fire)`` arms a daemon ``threading.Timer`` that
+    invokes ``fire`` (which appends the retry's prepare future to the
+    loop's ``pending`` deque) after ``delay`` seconds. ``pending()``
+    counts armed timers; it is decremented only *after* ``fire`` has
+    run, so the drain-loop exit condition ``pending() == 0`` implies
+    every retry has already re-entered the queue. ``wait_any`` parks
+    the drain loop until some timer fires (or the poll interval
+    elapses) instead of spinning."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._armed = 0
+        self._fired = threading.Event()
+        self._timers: List[threading.Timer] = []
+
+    def schedule(self, delay: float, fire: Callable[[], None]) -> None:
+        if delay <= 0:
+            fire()
+            return
+        with self._lock:
+            self._armed += 1
+
+        def _run() -> None:
+            try:
+                fire()
+            finally:
+                with self._lock:
+                    self._armed -= 1
+                self._fired.set()
+
+        t = threading.Timer(delay, _run)
+        t.daemon = True  # never blocks interpreter exit on a crashed run
+        with self._lock:
+            self._timers = [x for x in self._timers if x.is_alive()]
+            self._timers.append(t)
+        t.start()
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._armed
+
+    def wait_any(self, timeout: float = 0.05) -> None:
+        self._fired.wait(timeout)
+        self._fired.clear()
+
+
+def stack_group(payload_heads: Sequence[Any], pad_to: Optional[int] = None):
+    """Host-side group assembly helper: stack per-video arrays along a
+    new leading axis and (optionally) pad the group axis to the full
+    ``--video_batch`` so partial flushes keep the compiled shape."""
+    import numpy as np
+
+    from video_features_tpu.ops.window import pad_batch
+
+    arr = np.stack(payload_heads)
+    if pad_to is not None and arr.shape[0] < pad_to:
+        arr = pad_batch(arr, pad_to)
+    return arr

@@ -20,6 +20,7 @@ from PIL import Image
 import jax
 import jax.numpy as jnp
 
+from video_features_tpu.extract import ingest
 from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.paths import video_path_of
 from video_features_tpu.io.video import extract_frames
@@ -40,6 +41,7 @@ from video_features_tpu.ops.preprocess import (
     to_float_chw,
 )
 from video_features_tpu.ops.resize import fused_resize_crop_banded
+from video_features_tpu.ops.sampler import copy_forward, frame_delta_keep_mask
 from video_features_tpu.ops.window import bucket_size, pad_batch, pad_hw, spatial_bucket
 
 
@@ -153,9 +155,13 @@ class ExtractCLIP(BaseExtractor):
         else:
             params = jax.device_put(cast(self._load_host_params()), device)
 
-            @jax.jit
             def encode_image(p, x):
                 return model.apply({"params": p}, x)
+
+            # the frame batch is freshly placed per dispatch, so the
+            # entry donates it: XLA reuses the ingest HBM in place
+            # (extract/ingest.py; CPU can't alias and keeps a copy)
+            encode_image = ingest.jit_donated(encode_image, donate_argnums=(1,))
 
         state = {"params": params, "encode_image": encode_image,
                  "device": device, "pad_data": not context}
@@ -196,7 +202,11 @@ class ExtractCLIP(BaseExtractor):
                     ),
                 )
             else:
-                encode_raw = jax.jit(encode_raw)
+                # donate the raw uint8 frames (freshly placed per
+                # dispatch by transfer_group / place_raw_payload); the
+                # lru_cached resize taps are NOT donated — they are
+                # reused across every video sharing a source resolution
+                encode_raw = ingest.jit_donated(encode_raw, donate_argnums=(1,))
             state["encode_raw"] = encode_raw
         return state
 
@@ -228,6 +238,21 @@ class ExtractCLIP(BaseExtractor):
         frames, fps, timestamps_ms = extract_frames(
             video_path, self.config.extract_method, self.config.decoder
         )
+        # --frame_delta_threshold: drop near-duplicate sampled frames on
+        # the host, BEFORE padding/H2D; the fetch path copy-forwards
+        # their feature rows back onto the full grid. ``keep=None``
+        # means the gate is off or kept everything — the payload (and
+        # therefore the features) is then bit-identical to an ungated
+        # run.
+        keep = None
+        thr = getattr(self.config, "frame_delta_threshold", None)
+        if thr is not None:
+            mask = frame_delta_keep_mask(frames, float(thr))
+            skipped = int(mask.size - int(mask.sum()))
+            if skipped:
+                self._note_windows_skipped(path_entry, skipped, int(mask.size))
+                keep = mask
+                frames = [f for f, k in zip(frames, mask) if k]
         if self._device_preprocess_enabled():
             # raw uint8 HWC frames, padded (time bucket x spatial bucket);
             # resize/crop/normalize happens inside encode_raw on-device.
@@ -244,7 +269,7 @@ class ExtractCLIP(BaseExtractor):
             )
             arr = pad_batch(arr, bucket_size(T, buckets=self.config.shape_buckets))
             arr = pad_hw(arr, bh, bw)
-            return (arr, (wt_y, idx_y), (wt_x, idx_x)), T, fps, timestamps_ms
+            return (arr, (wt_y, idx_y), (wt_x, idx_x)), T, fps, timestamps_ms, keep
         batch = self._preprocess_frames(frames)  # (T, 3, H, W)
         T = batch.shape[0]
         padded = pad_batch(batch, bucket_size(T, buckets=self.config.shape_buckets))
@@ -256,7 +281,7 @@ class ExtractCLIP(BaseExtractor):
             import ml_dtypes
 
             padded = padded.astype(ml_dtypes.bfloat16)
-        return padded, T, fps, timestamps_ms
+        return padded, T, fps, timestamps_ms, keep
 
     # device half, split for the device pipeline (extract/base.py): enqueue
     # transfer + async forward, fetch later — video k+1's transfer/compute
@@ -273,20 +298,23 @@ class ExtractCLIP(BaseExtractor):
         return place_batch(padded, state["device"], spec=P())
 
     def dispatch_prepared(self, device, state, path_entry, payload):
-        padded, T, fps, timestamps_ms = payload
+        padded, T, fps, timestamps_ms, keep = payload
         if isinstance(padded, tuple):  # --preprocess device
             from video_features_tpu.parallel.sharding import place_raw_payload
 
             x_u8, wy, wx = place_raw_payload(padded, state["device"])
             out = state["encode_raw"](state["params"], x_u8, wy, wx)
-            return out, T, fps, timestamps_ms
+            return out, T, fps, timestamps_ms, keep
         x = self._place(state, padded)
-        return state["encode_image"](state["params"], x), T, fps, timestamps_ms
+        return state["encode_image"](state["params"], x), T, fps, timestamps_ms, keep
 
     def fetch_dispatched(self, handle) -> Dict[str, np.ndarray]:
-        out, T, fps, timestamps_ms = handle
+        out, T, fps, timestamps_ms, keep = handle
+        feats = np.asarray(out)[:T]
+        if keep is not None:  # gated: expand kept rows to the full grid
+            feats = copy_forward(feats, keep)
         return {
-            self.feature_type: np.asarray(out)[:T],
+            self.feature_type: feats,
             "fps": np.array(fps),
             "timestamps_ms": np.array(timestamps_ms),
         }
@@ -318,7 +346,13 @@ class ExtractCLIP(BaseExtractor):
             return None
         return head.shape  # the bucketed (T_pad, 3, H, W) shape
 
-    def dispatch_group(self, device, state, entries, payloads):
+    def transfer_group(self, device, state, entries, payloads):
+        """The dedicated H2D stage of the async ingest pipeline: stack
+        the group's host arrays and device_put them NOW (under the
+        loop's ``h2d`` span), so the fused forward in
+        ``dispatch_group`` enqueues against already-staged buffers —
+        and those buffers, fresh per group, are what the donated
+        entries (``donate_argnums``) let XLA reuse in place."""
         group = max(int(self.config.video_batch or 1), 1)
         head = payloads[0][0]
         if isinstance(head, tuple):  # --preprocess device: per-video
@@ -337,26 +371,47 @@ class ExtractCLIP(BaseExtractor):
 
             # mesh never groups (agg_key returns None there), so this is
             # always the plain queue-mode device_put of the fused tuple
-            xs, wys, wxs = place_raw_payload((xs, wys, wxs), state["device"])
-            out = state["encode_raw"](state["params"], xs, wys, wxs)
-            metas = [(i * bucket, p[1], p[2], p[3]) for i, p in enumerate(payloads)]
-            return out, metas
+            placed = place_raw_payload((xs, wys, wxs), state["device"])
+            metas = [
+                (i * bucket, p[1], p[2], p[3], p[4])
+                for i, p in enumerate(payloads)
+            ]
+            return ingest.StagedGroup(placed, metas)
         bucket = head.shape[0]
         x = np.concatenate([p[0] for p in payloads], axis=0)
         if len(payloads) < group:  # partial flush: keep the compiled shape
             x = pad_batch(x, group * bucket)
-        out = state["encode_image"](state["params"], self._place(state, x))
-        metas = [(i * bucket, p[1], p[2], p[3]) for i, p in enumerate(payloads)]
+        metas = [
+            (i * bucket, p[1], p[2], p[3], p[4]) for i, p in enumerate(payloads)
+        ]
+        return ingest.StagedGroup((self._place(state, x),), metas)
+
+    def dispatch_group(self, device, state, entries, payloads):
+        if not isinstance(payloads, ingest.StagedGroup):
+            # direct callers (and any path skipping the transfer stage)
+            # still get the assemble+place+dispatch composition
+            payloads = self.transfer_group(device, state, entries, payloads)
+        arrays, metas = payloads.arrays, payloads.metas
+        if len(arrays) == 3:  # --preprocess device: fused raw entry
+            xs, wys, wxs = arrays
+            out = state["encode_raw"](state["params"], xs, wys, wxs)
+        else:
+            out = state["encode_image"](state["params"], arrays[0])
         return out, metas
 
     def fetch_group(self, handle):
         out, metas = handle
         arr = np.asarray(out)
-        return [
-            {
-                self.feature_type: arr[off : off + t],
-                "fps": np.array(fps),
-                "timestamps_ms": np.array(ts),
-            }
-            for off, t, fps, ts in metas
-        ]
+        dicts = []
+        for off, t, fps, ts, keep in metas:
+            feats = arr[off : off + t]
+            if keep is not None:  # gated: expand to the full grid
+                feats = copy_forward(feats, keep)
+            dicts.append(
+                {
+                    self.feature_type: feats,
+                    "fps": np.array(fps),
+                    "timestamps_ms": np.array(ts),
+                }
+            )
+        return dicts

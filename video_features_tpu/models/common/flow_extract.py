@@ -212,11 +212,18 @@ class PairwiseFlowExtractor(BaseExtractor):
                     x = device_resize_frames(xs_u8, wy, wx)
                     return jax.vmap(lambda w: model.apply({"params": p}, w))(x)
 
-                fns["forward_raw"] = jax.jit(
-                    forward_raw, **multihost_out_kwargs(device)
+                from video_features_tpu.extract import ingest
+
+                # donate only the raw uint8 windows (argnum 1): they are
+                # placed fresh per call, while the banded taps are
+                # placed once per video and reused across its windows
+                fns["forward_raw"] = ingest.jit_donated(
+                    forward_raw, donate_argnums=(1,),
+                    **multihost_out_kwargs(device)
                 )
-                fns["forward_raw_group"] = jax.jit(
-                    forward_raw_group, **multihost_out_kwargs(device)
+                fns["forward_raw_group"] = ingest.jit_donated(
+                    forward_raw_group, donate_argnums=(1,),
+                    **multihost_out_kwargs(device)
                 )
 
         return fns

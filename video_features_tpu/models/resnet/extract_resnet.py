@@ -89,11 +89,15 @@ class ExtractResNet(BaseExtractor):
         forward = jit_sharded_forward(forward, device, n_out=2)
         state = {"params": params, "forward": forward, "device": device}
         if self._device_preprocess_enabled() and not is_mesh(device):
+            from video_features_tpu.extract import ingest
+
             # --preprocess device (sanity_check excludes mesh for ResNet;
             # the `not is_mesh` conjunct makes that visible to GC50x):
             # raw uint8 frames + the video's banded resize/crop taps fuse
-            # the bilinear-256/crop-224/normalize chain into the forward
-            @jax.jit
+            # the bilinear-256/crop-224/normalize chain into the forward.
+            # Only the frame chunk (argnum 1) is donated — it is placed
+            # fresh per call, while the taps (wy_d/wx_d) are reused
+            # across every chunk of a video and must stay alive.
             def forward_raw(p, x_u8, wy, wx):
                 x = device_preprocess_frames(
                     x_u8, wy, wx, IMAGENET_MEAN, IMAGENET_STD, out_dtype=dt
@@ -103,7 +107,6 @@ class ExtractResNet(BaseExtractor):
             # --video_batch: rows from different videos share a chunked
             # forward; ids gather each row's own source-resolution taps
             # from the stacked per-video matrices
-            @jax.jit
             def forward_raw_group(p, x_u8, wy_vids, wx_vids, ids):
                 x = device_preprocess_frames(
                     x_u8,
@@ -113,8 +116,12 @@ class ExtractResNet(BaseExtractor):
                 )
                 return model.apply({"params": p}, x)
 
-            state["forward_raw"] = forward_raw
-            state["forward_raw_group"] = forward_raw_group
+            state["forward_raw"] = ingest.jit_donated(
+                forward_raw, donate_argnums=(1,)
+            )
+            state["forward_raw_group"] = ingest.jit_donated(
+                forward_raw_group, donate_argnums=(1,)
+            )
         return state
 
     def _preprocess_batch(self, batch: List[np.ndarray]) -> np.ndarray:

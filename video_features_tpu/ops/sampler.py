@@ -16,6 +16,8 @@ and ``zeros``/``border`` padding.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
 
@@ -91,3 +93,46 @@ def bilinear_sampler(
         m = (xgrid > -1) & (ygrid > -1) & (xgrid < 1) & (ygrid < 1)
         return out, m.astype(img.dtype)
     return out
+
+
+# --- frame-delta gating (--frame_delta_threshold) -------------------------
+#
+# FASTER (PAPERS.md) observes that adjacent sampled frames of real video
+# are largely redundant; for frame-level extractors (the CLIP family) a
+# near-duplicate frame's feature can be copied from its predecessor
+# instead of re-encoded. The gate runs host-side on the decoded uint8
+# frames — skipped frames never cross H2D — and the fetch path expands
+# the kept rows back to the full sampling grid with ``copy_forward``.
+
+
+def frame_delta_keep_mask(frames, threshold: float) -> np.ndarray:
+    """Boolean keep-mask over ``frames`` (sequence of HWC uint8 arrays).
+
+    Frame 0 is always kept. Frame i is SKIPPED when its mean absolute
+    uint8 delta vs the last *kept* frame is strictly below
+    ``threshold`` — comparing against the last kept (not merely
+    previous) frame bounds the accumulated drift of a long
+    slowly-changing shot to one threshold, and the strict inequality
+    makes ``threshold=0`` keep every frame (the bit-identical parity
+    contract for the flag's zero value)."""
+    n = len(frames)
+    keep = np.ones(n, dtype=bool)
+    if n <= 1 or threshold <= 0:
+        return keep
+    last = np.asarray(frames[0], dtype=np.int16)
+    for i in range(1, n):
+        cur = np.asarray(frames[i], dtype=np.int16)
+        if float(np.mean(np.abs(cur - last))) < threshold:
+            keep[i] = False
+        else:
+            last = cur
+    return keep
+
+
+def copy_forward(rows: np.ndarray, keep: np.ndarray) -> np.ndarray:
+    """Expand per-kept-frame feature ``rows`` back to the full sampling
+    grid: position i takes the row of the latest kept frame at or
+    before i (``keep[0]`` is always True, so every position has one).
+    ``rows`` has ``keep.sum()`` rows; the result has ``keep.size``."""
+    keep = np.asarray(keep, dtype=bool)
+    return rows[np.cumsum(keep) - 1]

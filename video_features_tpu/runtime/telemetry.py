@@ -634,6 +634,16 @@ class Telemetry:
         depth = self.metrics.gauge("queue_depth.admission")
         if depth is not None:
             line += f", queue {int(depth)}"
+        # async-ingest pipeline depths (extract/base.py::_run_pipelined):
+        # dispatched-but-unfetched device groups and host-resident
+        # prepared payloads waiting to dispatch — a stalled pipeline
+        # shows up here live, not just post-hoc in the overlap report
+        inflight = self.metrics.gauge("queue_depth.inflight")
+        prepared = self.metrics.gauge("queue_depth.prepared")
+        if inflight is not None or prepared is not None:
+            line += (
+                f", inflight {int(inflight or 0)}, prepared {int(prepared or 0)}"
+            )
         return line
 
     def spans(self) -> List[Dict[str, Any]]:

@@ -153,6 +153,13 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 "Serve requests reaching each lifecycle state (terminal "
                 "states plus admitted/deferred/requeued).",
             ).add({"state": name[len("requests_"):]}, value)
+        elif name == "windows_skipped":
+            fam(
+                f"{METRIC_PREFIX}windows_skipped_total", "counter",
+                "Near-duplicate sampled frames skipped before H2D by "
+                "--frame_delta_threshold (features filled by "
+                "copy-forward; see docs/tpu.md).",
+            ).add(None, value)
         else:
             fam(
                 f"{METRIC_PREFIX}{sanitize_metric_name(name)}_total", "counter",
@@ -163,7 +170,9 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
             fam(
                 f"{METRIC_PREFIX}queue_depth", "gauge",
                 "Live queue depths by queue name (admission = requests "
-                "admitted but not yet terminal; the backpressure bound).",
+                "admitted but not yet terminal; inflight = dispatched "
+                "device groups not yet fetched; prepared = host-resident "
+                "payloads waiting to dispatch; the backpressure bounds).",
             ).add({"queue": name[len("queue_depth."):]}, value)
         elif name.startswith("device_mem_bytes."):
             # DeviceMemorySampler gauges: "device_mem_bytes.<device>|<kind>"

@@ -18,7 +18,11 @@ def synth_video(
     height: int = 240,
     fps: float = 25.0,
     seed: int = 0,
+    static: bool = False,
 ) -> str:
+    """``static=True`` freezes the scene: every frame repeats frame 0's
+    gradient+box (modulo codec noise) — the near-duplicate corpus the
+    --frame_delta_threshold gate and its bench/tests are pinned on."""
     import cv2
 
     writer = cv2.VideoWriter(
@@ -28,17 +32,23 @@ def synth_video(
     rng = np.random.RandomState(seed)
     yy, xx = np.mgrid[0:height, 0:width]
     for t in range(n_frames):
+        ts = 0 if static else t
         frame = np.stack(
             [
-                (xx + 2 * t) % 256,
-                (yy + t) % 256,
-                np.full((height, width), (t * 4) % 256),
+                (xx + 2 * ts) % 256,
+                (yy + ts) % 256,
+                np.full((height, width), (ts * 4) % 256),
             ],
             axis=-1,
         ).astype(np.uint8)
-        x0 = (10 + 3 * t) % (width - 40)
-        y0 = (20 + 2 * t) % (height - 40)
-        frame[y0 : y0 + 30, x0 : x0 + 30] = rng.randint(0, 255, 3)
+        x0 = (10 + 3 * ts) % (width - 40)
+        y0 = (20 + 2 * ts) % (height - 40)
+        color = rng.randint(0, 255, 3)  # one rng draw per frame either way
+        if static and t > 0:
+            color = box_color
+        else:
+            box_color = color
+        frame[y0 : y0 + 30, x0 : x0 + 30] = color
         writer.write(frame)
     writer.release()
     return path
