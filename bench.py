@@ -1527,6 +1527,130 @@ def _sub_ingest_overlap() -> dict:
     return out
 
 
+def _sub_cache_serving() -> dict:
+    """Content-addressed cache acceptance part (docs/serving.md
+    'Feature caching', ISSUE 17): warm-hit latency vs cold extraction on
+    the serve admission path, effective throughput under a Zipf-skewed
+    request stream, and the shared-decode fan-out's decode-once +
+    bit-identity claims on the batch path.
+
+    Gated keys: ``cache_hit_latency_ms`` (the admission short-circuit —
+    hash memo + store lookup + materialize; the thing this subsystem
+    exists to keep cheap) and ``cache_hit_speedup`` with its >= 10x
+    ``cache_hit_within_budget`` hard gate. The fan-out booleans
+    (``*_decode_once_*``, ``*_bitmatch_*``) are hard gates too.
+    Cold-extraction wall and the effective-vps projections are
+    host-capability sizing numbers — named without unit suffixes so the
+    --compare sentinel treats them as informational (this part runs
+    CPU-pinned on heterogeneous containers)."""
+    import statistics
+
+    from video_features_tpu import cli
+    from video_features_tpu.config import parse_serve_args
+    from video_features_tpu.serve.daemon import ServeDaemon
+    from video_features_tpu.utils.synth import synth_video
+
+    n_corpus, n_stream = 6, 24
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        vids = [
+            synth_video(os.path.join(tmp, f"v{i}.mp4"),
+                        n_frames=10, width=96, height=64, seed=i)
+            for i in range(n_corpus)
+        ]
+        scfg = parse_serve_args([
+            "--feature_types", "resnet18",
+            "--output_path", os.path.join(tmp, "out"),
+            "--tmp_path", os.path.join(tmp, "tmp"),
+            "--cache_dir", os.path.join(tmp, "store"),
+            "--allow_random_init", "--cpu", "--heartbeat_s", "0",
+            "--on_extraction", "save_numpy",
+        ])
+        d = ServeDaemon(scfg)
+        seq = iter(range(10_000))
+
+        def run_one(vid: str) -> float:
+            # submit + inline drain; a cache hit is terminal at submit
+            # and the drain is a no-op, so one timer covers both paths
+            t0 = time.perf_counter()
+            d.submit({"feature_type": "resnet18", "video_path": vid,
+                      "id": f"bench-{next(seq)}"}, source="local")
+            for g in d.batcher.take_ready(now=float("inf")):
+                d.batcher._run_group(g)
+            return time.perf_counter() - t0
+
+        run_one(vids[0])  # sacrificial: model build + first jit
+        cold = [run_one(v) for v in vids[1:]]   # misses: extract + publish
+        hits = [run_one(vids[1]) for _ in range(5)]  # admission hits
+        cold_s = statistics.median(cold)
+        hit_s = min(hits)
+        # Zipf-skewed replay over the (now fully cached) corpus — the
+        # skew every real request log has; achieved hit rate is 1.0 here
+        # by construction, so the stream measures steady-state hit cost
+        rng = np.random.default_rng(0)
+        ranks = (rng.zipf(1.5, size=n_stream) - 1) % n_corpus
+        t0 = time.perf_counter()
+        for r in ranks:
+            run_one(vids[int(r)])
+        zipf_wall = time.perf_counter() - t0
+        counts = d.tracker.counts()
+        d.shutdown()
+
+        # shared-decode fan-out on the batch path: one decoder open per
+        # video for BOTH models, outputs bit-identical to single runs
+        import video_features_tpu.io.video as vio
+
+        fts = ["resnet18", "CLIP-ViT-B/32"]
+        fan_vids = vids[:2]
+        common = ["--video_paths", *fan_vids, "--tmp_path",
+                  os.path.join(tmp, "tmp"), "--allow_random_init", "--cpu",
+                  "--extract_method", "fix_2", "--on_extraction",
+                  "save_numpy", "--heartbeat_s", "0"]
+        for ft in fts:
+            cli.main(["--feature_type", ft, "--output_path",
+                      os.path.join(tmp, "single"), "--ingest_cache_mb", "0",
+                      *common])
+        opens = []
+        real_init = vio._Reader.__init__
+        vio._Reader.__init__ = (
+            lambda self, *a, **kw: opens.append(a) or real_init(self, *a, **kw)
+        )
+        try:
+            cli.main(["--feature_types", *fts, "--output_path",
+                      os.path.join(tmp, "fanout"), *common])
+        finally:
+            vio._Reader.__init__ = real_init
+        bitmatch = all(
+            np.array_equal(
+                np.load(os.path.join(tmp, "fanout", ft,
+                                     f"v{i}_{ft.replace('/', '-')}.npy")),
+                np.load(os.path.join(tmp, "single", ft,
+                                     f"v{i}_{ft.replace('/', '-')}.npy")),
+            )
+            for ft in fts for i in range(len(fan_vids))
+        )
+
+    def vps_at(h: float) -> float:
+        return 1.0 / (h * hit_s + (1.0 - h) * cold_s)
+
+    out["cache_hit_latency_ms"] = round(hit_s * 1000.0, 3)
+    out["cache_cold_extract_wall"] = round(cold_s, 3)  # seconds; info key
+    out["cache_hit_speedup"] = round(cold_s / max(hit_s, 1e-9), 1)
+    out["cache_hit_within_budget"] = cold_s / max(hit_s, 1e-9) >= 10.0
+    out["cache_effective_vps_hit0"] = round(vps_at(0.0), 3)
+    out["cache_effective_vps_hit50"] = round(vps_at(0.5), 3)
+    out["cache_effective_vps_hit90"] = round(vps_at(0.9), 3)
+    out["cache_zipf_stream_requests"] = n_stream
+    out["cache_zipf_stream_wall"] = round(zipf_wall, 3)  # seconds; info key
+    out["cache_serve_requests_done"] = counts.get("done", 0)
+    out["cache_serve_requests_failed"] = counts.get("failed", 0)
+    out["cache_fanout_reader_opens"] = len(opens)
+    out["cache_fanout_videos"] = len(fan_vids)
+    out["cache_fanout_decode_once_within_budget"] = len(opens) == len(fan_vids)
+    out["cache_fanout_bitmatch_within_budget"] = bool(bitmatch)
+    return out
+
+
 SUB_PARTS = {
     "clip_e2e": _sub_clip_e2e,
     "clip_bf16": _sub_clip_bf16,
@@ -1551,6 +1675,7 @@ SUB_PARTS = {
     "metrics_endpoint_overhead": _sub_metrics_endpoint_overhead,
     "ledger_overhead": _sub_ledger_overhead,
     "ingest_overlap": _sub_ingest_overlap,
+    "cache_serving": _sub_cache_serving,
 }
 
 
@@ -1956,6 +2081,11 @@ def main() -> None:
     # the stage-sequential serial loop + --frame_delta_threshold skip
     # rate on a static corpus (CPU-pinned: measures the loop, not the chip)
     extra.update(_spawn_sub("ingest_overlap", 900.0, env={"JAX_PLATFORMS": "cpu"}))
+    emit()
+    # content-addressed cache: warm-hit vs cold-extract on the serve
+    # admission path + shared-decode fan-out decode-once/bit-identity
+    # hard gates (CPU-pinned: relative numbers are the artifact)
+    extra.update(_spawn_sub("cache_serving", 900.0, env={"JAX_PLATFORMS": "cpu"}))
     emit()
 
     if not _probe_backend(fatal=False):

@@ -171,6 +171,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "serve_scheduling": {"serve_sched_edf_miss_rate": 0.0},
         "ledger_overhead": {"ledger_overhead_us_per_video": 16.0},
         "ingest_overlap": {"ingest_overlap_efficiency": 0.02},
+        "cache_serving": {"cache_hit_speedup": 400.0},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -207,6 +208,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["serve_sched_edf_miss_rate"] == 0.0
     assert final["extra"]["ledger_overhead_us_per_video"] == 16.0
     assert final["extra"]["ingest_overlap_efficiency"] == 0.02
+    assert final["extra"]["cache_hit_speedup"] == 400.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -250,6 +252,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"ledger_overhead_us_per_video": 16.0}
         if name == "ingest_overlap":  # loop-structure bench, CPU-pinned
             return {"ingest_overlap_efficiency": 0.02}
+        if name == "cache_serving":  # cache + fan-out bench, CPU-pinned
+            return {"cache_hit_speedup": 400.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

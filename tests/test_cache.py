@@ -1,0 +1,454 @@
+"""Content-addressed feature cache + shared-decode fan-out (ISSUE 17).
+
+Pins the cache's safety contract — a wrong hit is never possible — and
+the fan-out's economy contract — N models over one video decode its
+bytes exactly once, bit-identically to N separate runs:
+
+- content_hash: fast/full modes both detect a content change; the
+  (path, size, mtime) memo spares repeat hashing; unreadable input
+  raises (the callers treat that as "not cacheable", never as a hit).
+- config_digest: any knob that changes extracted bytes changes the
+  digest; knobs that don't (output_path) don't.
+- FeatureCache: publish/lookup roundtrip, claim-by-rename makes the
+  second publisher a no-op loser, corrupt entry.json and torn payloads
+  degrade to a miss.
+- Batch: a second identical run resolves every video as a manifest
+  ``cache_hit``; a config change or content change misses.
+- Fan-out: CLIP+ResNet over one corpus opens exactly one decoder per
+  video and matches the single-model runs byte for byte.
+- Serve: admission-time hits return a terminal record with no dispatch,
+  the multi-model request form fans out, and the cache shows up on
+  /v1/stats and as ``vft_cache_*`` on /metrics.
+"""
+
+import json
+import os
+import shutil
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from video_features_tpu import cli
+from video_features_tpu.config import ExtractionConfig, parse_serve_args, sanity_check
+from video_features_tpu.extract import cache as fcache
+from video_features_tpu.extract.cache import (
+    FeatureCache,
+    config_digest,
+    content_hash,
+    feature_keys_for,
+)
+from video_features_tpu.extract.plan import SharedFrameCache
+from video_features_tpu.extract.registry import media_need_for
+from video_features_tpu.runtime.faults import iter_manifest_records
+from video_features_tpu.serve.daemon import ServeDaemon
+from video_features_tpu.serve.server import start_http_server
+from video_features_tpu.telemetry.exposition import (
+    check_exposition,
+    families_from_snapshot,
+    render_families,
+)
+
+pytestmark = pytest.mark.cache
+
+
+# --- content hashing --------------------------------------------------------
+
+
+def _blob(tmp_path, name="blob.bin", size=4 << 20, seed=0):
+    rng = np.random.default_rng(seed)
+    p = str(tmp_path / name)
+    with open(p, "wb") as fh:
+        fh.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    return p
+
+
+def _flip_byte(p, offset):
+    with open(p, "r+b") as fh:
+        fh.seek(offset)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+@pytest.mark.parametrize("mode", ["fast", "full"])
+def test_content_hash_detects_header_edit(tmp_path, mode):
+    # the header is covered by BOTH modes (fast reads the first 1 MiB)
+    p = _blob(tmp_path)
+    before = content_hash(p, mode)
+    assert before == content_hash(p, mode)  # deterministic
+    _flip_byte(p, 4096)
+    assert content_hash(p, mode) != before
+
+
+def test_full_hash_covers_what_fast_samples_past(tmp_path):
+    # fast is a sampled hash: a flip BETWEEN its sampled chunks is the
+    # blind spot --cache_hash full exists for. Pin both sides of the
+    # tradeoff so a resampling change that closes (or widens) the gap
+    # shows up here.
+    p = _blob(tmp_path)
+    fast, full = content_hash(p, "fast"), content_hash(p, "full")
+    _flip_byte(p, (4 << 20) // 2)  # mid-file, outside fast's samples
+    assert content_hash(p, "full") != full
+    assert content_hash(p, "fast") == fast
+
+
+def test_content_hash_modes_differ_and_size_prefix(tmp_path):
+    p = _blob(tmp_path)
+    assert content_hash(p, "fast") != content_hash(p, "full")
+    with pytest.raises(ValueError):
+        content_hash(p, "sampled")
+
+
+def test_content_hash_memo_spares_rereads(tmp_path, monkeypatch):
+    p = _blob(tmp_path, size=1 << 20)
+    content_hash(p, "fast")  # prime
+    calls = []
+    real = fcache._hash_bytes
+    monkeypatch.setattr(
+        fcache, "_hash_bytes", lambda *a: calls.append(a) or real(*a)
+    )
+    h1 = content_hash(p, "fast")
+    h2 = content_hash(p, "fast")
+    assert h1 == h2 and calls == []  # memo hit: bytes never re-read
+    # a rewrite (new mtime) invalidates the memo
+    with open(p, "r+b") as fh:
+        fh.write(b"\x00")
+    os.utime(p, ns=(1, 1))
+    content_hash(p, "fast")
+    assert len(calls) == 1
+
+
+def test_content_hash_unreadable_raises(tmp_path):
+    with pytest.raises(OSError):
+        content_hash(str(tmp_path / "missing.mp4"))
+
+
+def test_audio_inputs_hash_like_video(tmp_path, sample_wav):
+    # VGGish requests key on the same byte-content hash — audio is
+    # cacheable through the identical code path
+    assert media_need_for("vggish") == "audio"
+    assert media_need_for("resnet18") == "video"
+    assert len(content_hash(sample_wav)) == 64
+
+
+# --- config digest ----------------------------------------------------------
+
+
+def _cfg(**kw):
+    kw.setdefault("feature_type", "resnet18")
+    kw.setdefault("video_paths", ["/v.mp4"])
+    return ExtractionConfig(**kw)
+
+
+def test_config_digest_tracks_extraction_knobs():
+    base = _cfg()
+    assert config_digest(base) == config_digest(_cfg())
+    # knobs that change the bytes change the digest
+    assert config_digest(base) != config_digest(_cfg(extraction_fps=5.0))
+    assert config_digest(base) != config_digest(_cfg(feature_type="resnet50"))
+    assert config_digest(base) != config_digest(_cfg(side_size=100))
+    # knobs that don't (where the files land, batch shape) don't
+    assert config_digest(base) == config_digest(_cfg(output_path="/elsewhere"))
+    assert config_digest(base) == config_digest(_cfg(video_paths=["/other.mp4"]))
+
+
+def test_feature_keys_for_i3d_streams():
+    assert feature_keys_for(_cfg()) == ["resnet18"]
+    assert feature_keys_for(_cfg(feature_type="i3d")) == ["rgb", "flow"]
+    assert feature_keys_for(_cfg(feature_type="i3d", streams="rgb")) == ["rgb"]
+
+
+# --- store: publish / lookup / corruption ----------------------------------
+
+
+def _store_with_entry(tmp_path, key="resnet18"):
+    video = _blob(tmp_path, "clip.bin", size=1 << 16, seed=3)
+    feat = str(tmp_path / f"x_{key}.npy")
+    np.save(feat, np.arange(12, dtype=np.float32))
+    store = FeatureCache(str(tmp_path / "store"))
+    chash = store.content_hash(video)
+    assert store.publish(chash, "d" * 16, {key: feat}, feature_type=key)
+    return store, chash, video, feat
+
+
+def test_publish_lookup_materialize_roundtrip(tmp_path):
+    store, chash, _, feat = _store_with_entry(tmp_path)
+    hit = store.lookup(chash, "d" * 16, ["resnet18"])
+    assert hit is not None
+    dest = str(tmp_path / "out" / "x_resnet18.npy")
+    assert store.materialize(hit, {"resnet18": dest}) == [dest]
+    np.testing.assert_array_equal(np.load(dest), np.load(feat))
+    # wrong digest or wrong keys: miss, never a partial hit
+    assert store.lookup(chash, "e" * 16, ["resnet18"]) is None
+    assert store.lookup(chash, "d" * 16, ["resnet18", "flow"]) is None
+
+
+def test_second_publisher_loses_claim_by_rename(tmp_path):
+    store, chash, _, feat = _store_with_entry(tmp_path)
+    entry = store.entry_dir(chash, "d" * 16)
+    mtime = os.path.getmtime(os.path.join(entry, "entry.json"))
+    # replica 2 finishes the same work: publish is a no-op loser, the
+    # winner's entry is untouched, and no stage dir leaks
+    assert not store.publish(chash, "d" * 16, {"resnet18": feat})
+    assert os.path.getmtime(os.path.join(entry, "entry.json")) == mtime
+    assert os.listdir(os.path.join(store.root, ".tmp")) == []
+
+
+def test_corrupt_entry_json_is_a_miss(tmp_path):
+    store, chash, _, _ = _store_with_entry(tmp_path)
+    entry = store.entry_dir(chash, "d" * 16)
+    with open(os.path.join(entry, "entry.json"), "w") as fh:
+        fh.write('{"format_version"')  # torn mid-write
+    assert store.lookup(chash, "d" * 16, ["resnet18"]) is None
+
+
+def test_torn_payload_is_a_miss(tmp_path):
+    store, chash, _, _ = _store_with_entry(tmp_path)
+    entry = store.entry_dir(chash, "d" * 16)
+    with open(os.path.join(entry, "resnet18.npy"), "wb") as fh:
+        fh.write(b"\x00\x00")  # not the numpy magic: torn/corrupt
+    assert store.lookup(chash, "d" * 16, ["resnet18"]) is None
+
+
+# --- batch: hit / miss semantics end to end ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def cache_videos(tmp_path_factory):
+    from video_features_tpu.utils.synth import synth_video
+
+    d = tmp_path_factory.mktemp("cache_media")
+    return [
+        synth_video(str(d / f"v{i}.mp4"), n_frames=8, width=64, height=48, seed=i)
+        for i in range(2)
+    ]
+
+
+def _batch_argv(tmp_path, videos, out="out", **extra):
+    argv = [
+        "--feature_type", "resnet18",
+        "--video_paths", *videos,
+        "--output_path", str(tmp_path / out),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--cache_dir", str(tmp_path / "store"),
+        "--allow_random_init", "--cpu", "--on_extraction", "save_numpy",
+        "--heartbeat_s", "0",
+    ]
+    for k, v in extra.items():
+        argv += [f"--{k}"] + ([str(v)] if v is not True else [])
+    return argv
+
+
+def _hit_notes(out_dir):
+    return [
+        r for r in iter_manifest_records(str(out_dir))
+        if r.get("status") == "done" and r.get("note") == "cache_hit"
+    ]
+
+
+def test_batch_second_run_is_all_cache_hits(tmp_path, cache_videos):
+    cli.main(_batch_argv(tmp_path, cache_videos))
+    assert _hit_notes(tmp_path / "out") == []  # cold: all misses
+    first = np.load(tmp_path / "out" / "resnet18" / "v0_resnet18.npy")
+
+    cli.main(_batch_argv(tmp_path, cache_videos, out="out2"))
+    assert len(_hit_notes(tmp_path / "out2")) == len(cache_videos)
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "out2" / "resnet18" / "v0_resnet18.npy"), first
+    )
+
+    # a digest-relevant knob change misses (and repopulates under the
+    # new digest, so both entries coexist)
+    cli.main(_batch_argv(tmp_path, cache_videos, out="out3", extraction_fps=5))
+    assert _hit_notes(tmp_path / "out3") == []
+
+    # a content change misses: same path, new bytes
+    edited = str(tmp_path / "edited.mp4")
+    shutil.copyfile(cache_videos[0], edited)
+    with open(edited, "r+b") as fh:
+        fh.seek(-64, os.SEEK_END)
+        fh.write(b"\xff" * 8)
+    cli.main(_batch_argv(tmp_path, [edited], out="out4"))
+    assert _hit_notes(tmp_path / "out4") == []
+
+
+# --- fan-out: decode once, bit-identical ------------------------------------
+
+
+def test_fanout_decodes_once_and_matches_single_runs(tmp_path, cache_videos, monkeypatch):
+    import video_features_tpu.io.video as vio
+
+    fts = ["resnet18", "CLIP-ViT-B/32"]
+    # single-model baselines, no caches in play
+    for ft in fts:
+        cli.main([
+            "--feature_type", ft, "--video_paths", *cache_videos,
+            "--output_path", str(tmp_path / "single"),
+            "--tmp_path", str(tmp_path / "tmp"),
+            "--allow_random_init", "--cpu", "--extract_method", "fix_2",
+            "--on_extraction", "save_numpy", "--heartbeat_s", "0",
+            "--ingest_cache_mb", "0",
+        ])
+
+    opened = []
+    real_init = vio._Reader.__init__
+    monkeypatch.setattr(
+        vio._Reader, "__init__",
+        lambda self, *a, **kw: opened.append(a) or real_init(self, *a, **kw),
+    )
+    cli.main([
+        "--feature_types", *fts, "--video_paths", *cache_videos,
+        "--output_path", str(tmp_path / "fanout"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu", "--extract_method", "fix_2",
+        "--on_extraction", "save_numpy", "--heartbeat_s", "0",
+    ])
+    # the economy claim: one decoder per video for BOTH models
+    assert len(opened) == len(cache_videos)
+    # the correctness claim: shared decode is bit-identical per model
+    for ft in fts:
+        sub = ft.replace("/", "-")
+        for i in range(len(cache_videos)):
+            np.testing.assert_array_equal(
+                np.load(tmp_path / "fanout" / ft / f"v{i}_{sub}.npy"),
+                np.load(tmp_path / "single" / ft / f"v{i}_{sub}.npy"),
+            )
+
+
+def test_shared_frame_cache_budget_and_latch(tmp_path, cache_videos):
+    big = SharedFrameCache(max_bytes=64 << 20)
+    clip = big.acquire(cache_videos[0])
+    assert clip is not None and len(clip.frames) == 8
+    assert big.acquire(cache_videos[0]) is clip  # LRU hit, same object
+    assert big.stats()["populated"] == 1 and big.stats()["hits"] == 1
+    # an over-budget clip is abandoned: caller falls back to direct decode
+    tiny = SharedFrameCache(max_bytes=1024)
+    assert tiny.acquire(cache_videos[0]) is None
+    assert tiny.stats()["clips"] == 0
+    # concurrent acquirers converge on one decode
+    shared = SharedFrameCache(max_bytes=64 << 20)
+    got = []
+    ts = [
+        threading.Thread(target=lambda: got.append(shared.acquire(cache_videos[1])))
+        for _ in range(4)
+    ]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len({id(c) for c in got}) == 1 and shared.stats()["populated"] == 1
+
+
+# --- serve: admission-time hits, fan-out request form -----------------------
+
+
+def _post(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/extract", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read().decode()
+
+
+def test_serve_cache_and_fanout_end_to_end(tmp_path, cache_videos):
+    scfg = parse_serve_args([
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--cache_dir", str(tmp_path / "store"),
+        "--allow_random_init", "--cpu", "--heartbeat_s", "0",
+        "--on_extraction", "save_numpy",
+    ])
+    d = ServeDaemon(scfg)
+    d.start()
+    server, _ = start_http_server(d, "127.0.0.1", 0)
+    port = server.server_address[1]
+    try:
+        # warm the store through the real miss path: two cold videos
+        # queue normally, one inline drain finishes both
+        for rid, video in (("a", cache_videos[0]), ("e", cache_videos[1])):
+            code, rec = _post(port, {
+                "feature_type": "resnet18", "video_path": video, "id": rid,
+            })
+            assert code == 202 and rec["state"] == "queued"
+        d.batcher.close(drain=True)  # inline drain: deterministic
+        assert json.loads(_get(port, "/v1/requests/a")[1])["state"] == "done"
+
+        # identical request: terminal at admission, features listed, and
+        # the dispatch queue never sees it (the batcher is already
+        # closed — a hit that touched it would 503)
+        code, rec = _post(port, {
+            "feature_type": "resnet18", "video_path": cache_videos[0], "id": "b",
+        })
+        assert code == 202 and rec["state"] == "done" and rec["features"]
+        assert all(os.path.exists(f) for f in rec["features"])
+
+        # the fan-out request form (single-model daemon: list of one)
+        code, agg = _post(port, {
+            "feature_types": ["resnet18"], "video_path": cache_videos[0],
+            "id": "c",
+        })
+        assert code == 202 and agg["fanout"] is True
+        assert agg["requests"]["resnet18"]["state"] == "done"  # hit again
+        assert agg["requests"]["resnet18"]["id"] == "c.resnet18"
+
+        stats = json.loads(_get(port, "/v1/stats")[1])
+        assert stats["cache"]["enabled"]
+        assert stats["cache"]["hits"] == 2 and stats["cache"]["misses"] == 2
+        assert stats["cache"]["hit_rate"] == 0.5
+
+        text = _get(port, "/metrics")[1]
+        assert 'vft_cache_hit_total{feature_type="resnet18"} 2' in text
+        assert 'vft_cache_miss_total{feature_type="resnet18"} 2' in text
+        assert check_exposition(text) == []
+    finally:
+        server.shutdown()
+        d.shutdown()
+
+
+def test_fanout_request_validation(tmp_path, cache_videos):
+    scfg = parse_serve_args([
+        "--feature_types", "resnet18",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu", "--heartbeat_s", "0",
+    ])
+    d = ServeDaemon(scfg)
+    try:
+        from video_features_tpu.serve.lifecycle import BadRequest
+
+        with pytest.raises(BadRequest):  # empty list
+            d.submit({"feature_types": [], "video_path": cache_videos[0]}, source="http")
+        with pytest.raises(BadRequest):  # both forms at once
+            d.submit({
+                "feature_types": ["resnet18"], "feature_type": "resnet18",
+                "video_path": cache_videos[0],
+            }, source="http")
+        with pytest.raises(BadRequest):  # unserved member rejects the WHOLE list
+            d.submit({
+                "feature_types": ["resnet18", "i3d"],
+                "video_path": cache_videos[0],
+            }, source="http")
+        assert d.tracker.counts().get("queued", 0) == 0  # nothing half-admitted
+    finally:
+        d.shutdown()
+
+
+# --- exposition mapping -----------------------------------------------------
+
+
+def test_cache_counters_render_as_labelled_families():
+    fams = families_from_snapshot({
+        "counters": {"cache_hit.resnet18": 3, "cache_miss.CLIP-ViT-B/32": 1},
+        "gauges": {}, "histograms": {},
+    })
+    text = render_families(fams)
+    assert 'vft_cache_hit_total{feature_type="resnet18"} 3' in text
+    assert 'vft_cache_miss_total{feature_type="CLIP-ViT-B/32"} 1' in text
+    assert check_exposition(text) == []

@@ -120,6 +120,11 @@ THREAD_ROOT_PATTERNS = (
     # the probe runs on HTTP handler threads (serve admission) and the
     # batch main thread concurrently; it must hold no mutable globals
     "io/probe.py",
+    # the content-addressed store's hash memo is shared by every serve
+    # handler thread, and the shared frame cache's LRU + in-flight
+    # latches are mutated from concurrent extractor/decode threads
+    "extract/cache.py",
+    "extract/plan.py",
 )
 
 

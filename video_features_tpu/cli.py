@@ -10,7 +10,11 @@ scheduler — for both single- and multi-device runs.
 
 import sys
 
-from video_features_tpu.config import enable_compile_cache, parse_args
+from video_features_tpu.config import (
+    enable_compile_cache,
+    parse_batch_args,
+    sanity_check,
+)
 from video_features_tpu.extract.registry import build_extractor
 from video_features_tpu.parallel.devices import resolve_devices
 from video_features_tpu.parallel.scheduler import (
@@ -30,7 +34,7 @@ def main(argv=None) -> None:
         from video_features_tpu.serve.daemon import serve_main
 
         return serve_main(argv[1:])
-    cfg = parse_args(argv)
+    cfg, feature_types = parse_batch_args(argv)
     # before any device/compile touch, so every executable (including the
     # --preprocess device bucket grid) can hit/populate the on-disk cache
     enable_compile_cache(cfg)
@@ -52,25 +56,45 @@ def main(argv=None) -> None:
     if cfg.keep_tmp_files:
         print(f"Keeping temp files in {cfg.tmp_path}")
 
-    extractor = build_extractor(cfg)
-    devices = resolve_devices(cfg)
+    # multi-model runs (--feature_types A B ...) install the shared-decode
+    # frame cache for the whole loop: model A's pass decodes each clip
+    # once, every later model replays the cached frames (extract/plan.py)
+    from video_features_tpu.extract.plan import shared_frame_cache
+
+    summary = None
+    wrote_manifest = False
     try:
-        if cfg.sharding == "mesh":
-            mesh_feature_extraction(extractor, devices)
-        else:
-            parallel_feature_extraction(extractor, devices)
+        with shared_frame_cache(cfg, feature_types):
+            for ft in feature_types:
+                fcfg = (
+                    cfg
+                    if ft == cfg.feature_type
+                    else sanity_check(cfg.replace(feature_type=ft))
+                )
+                extractor = build_extractor(fcfg)
+                devices = resolve_devices(fcfg)
+                try:
+                    if fcfg.sharding == "mesh":
+                        mesh_feature_extraction(extractor, devices)
+                    else:
+                        parallel_feature_extraction(extractor, devices)
+                finally:
+                    # final telemetry drain BEFORE the manifest merge so
+                    # the summary's metrics/throughput block reflects the
+                    # whole run — including a run the scheduler aborted
+                    extractor.telemetry.close()
+                    wrote_manifest |= (
+                        getattr(extractor.manifest, "path", None) is not None
+                    )
     finally:
         # merge every process's JSONL events into _manifest/summary.json
         # and print the one-line outcome — even when the scheduler raised,
         # so a crashed run still leaves a machine-readable record of what
-        # completed (docs/robustness.md). Gated on this run actually
-        # recording (print-mode ad-hoc runs have no manifest dir).
-        summary = None
-        # final telemetry drain BEFORE the merge so the summary's
-        # metrics/throughput block (and the digest line below) reflect
-        # the whole run — including a run the scheduler aborted
-        extractor.telemetry.close()
-        if getattr(extractor.manifest, "path", None) is not None:
+        # completed (docs/robustness.md). One <output>/_manifest covers
+        # the whole multi-feature tree, so ONE merge at the end covers
+        # every model's pass. Gated on this run actually recording
+        # (print-mode ad-hoc runs have no manifest dir).
+        if wrote_manifest:
             from video_features_tpu.runtime.faults import finalize_run, format_summary
 
             summary = finalize_run(cfg.output_path)

@@ -302,6 +302,21 @@ class ExtractionConfig:
     #                  i3d parts to 'decomposed' on TPU for this reason).
     # Explicit direct/decomposed overrides the env var either way.
     conv3d_impl: str = "auto"
+    # --- content-addressed feature cache + shared-decode fan-out (ISSUE 17)
+    # Root of the content-addressed feature store (extract/cache.py):
+    # completed features keyed by (content hash, config digest) are
+    # served as a file copy instead of a decode + forward pass. None
+    # disables caching entirely.
+    cache_dir: Optional[str] = None
+    # 'fast' hashes size + head + sampled chunks + tail (never streams a
+    # multi-GB file on the admission path); 'full' streams every byte —
+    # the escape hatch for collision-paranoid setups.
+    cache_hash: str = "fast"
+    # Byte budget (MiB) for the shared-decode frame cache installed
+    # around multi-model fan-out runs (extract/plan.py): decode once,
+    # serve every requested model from the cached frames. 0 disables;
+    # single-model runs never install it regardless.
+    ingest_cache_mb: int = 512
 
     def __post_init__(self) -> None:
         if self.streams is not None and not isinstance(self.streams, (list, tuple)):
@@ -477,6 +492,14 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
             "combine with --attn flash/blockwise (ring already chunks KV "
             "blockwise per arriving shard)"
         )
+    if cfg.cache_hash not in ("fast", "full"):
+        raise ValueError(
+            f"cache_hash must be 'fast' or 'full', got {cfg.cache_hash!r}"
+        )
+    if cfg.ingest_cache_mb < 0:
+        raise ValueError(
+            f"ingest_cache_mb must be >= 0, got {cfg.ingest_cache_mb}"
+        )
     return cfg
 
 
@@ -505,7 +528,9 @@ def build_arg_parser(feature_required: bool = True) -> argparse.ArgumentParser:
     that pick the feature type per request (the ``serve`` daemon declares
     ``--feature_types`` instead)."""
     p = argparse.ArgumentParser(description="Extract features (TPU-native)")
-    p.add_argument("--feature_type", required=feature_required, choices=FEATURE_TYPES)
+    # required-ness is enforced post-parse (parse_batch_args): either
+    # --feature_type or the batch --feature_types list satisfies it
+    p.add_argument("--feature_type", required=False, choices=FEATURE_TYPES)
     p.add_argument("--video_paths", nargs="+", help="space-separated paths to videos")
     p.add_argument("--flow_paths", nargs="+", help="space-separated paths to video flow images")
     p.add_argument("--file_with_video_paths", help=".txt file where each line is a path")
@@ -671,12 +696,59 @@ def build_arg_parser(feature_required: bool = True) -> argparse.ArgumentParser:
                         "the transformer token axis over the mesh and run "
                         "ring attention (KV shards rotate over ICI); "
                         "composes with --mesh_model head sharding")
+    p.add_argument("--cache_dir", type=str, default=None,
+                   help="content-addressed feature store root: completed "
+                        "features keyed by (content hash, config digest) "
+                        "are reused as a file copy instead of re-"
+                        "extracting (docs/serving.md); omit to disable")
+    p.add_argument("--cache_hash", choices=["fast", "full"], default="fast",
+                   help="content hash mode: 'fast' samples head + spread "
+                        "chunks + tail (default; never streams a huge "
+                        "file), 'full' streams every byte")
+    p.add_argument("--ingest_cache_mb", type=int, default=512,
+                   help="byte budget (MiB) for the shared-decode frame "
+                        "cache used by multi-model fan-out: decode each "
+                        "clip once and serve all requested models from "
+                        "the cached frames; 0 disables")
+    if feature_required:
+        # batch fan-out: the serve parser adds its own --feature_types in
+        # the serve group, so this one only exists on the batch surface
+        p.add_argument(
+            "--feature_types", nargs="+", choices=FEATURE_TYPES,
+            help="extract SEVERAL feature types in one run, decoding each "
+                 "video once (shared-ingest fan-out, extract/plan.py); "
+                 "alternative to --feature_type")
     return p
 
 
+def parse_batch_args(
+    argv: Optional[Sequence[str]] = None,
+) -> "tuple[ExtractionConfig, List[str]]":
+    """Parse the batch CLI into ``(config, feature_types)``. Exactly one
+    of ``--feature_type`` / ``--feature_types`` is required; a multi-
+    model list routes cli.py through the shared-ingest fan-out
+    (extract/plan.py) — one decode per clip, every model served from it.
+    The returned config carries the FIRST feature type; callers re-key
+    with ``cfg.replace(feature_type=ft)`` per model."""
+    p = build_arg_parser()
+    args = p.parse_args(argv)
+    fts = list(
+        dict.fromkeys(
+            args.feature_types
+            or ([args.feature_type] if args.feature_type else [])
+        )
+    )
+    if not fts:
+        p.error("one of --feature_type or --feature_types is required")
+    args.feature_type = fts[0]
+    # from_namespace drops feature_types (not an ExtractionConfig field)
+    cfg = sanity_check(ExtractionConfig.from_namespace(args))
+    return cfg, fts
+
+
 def parse_args(argv: Optional[Sequence[str]] = None) -> ExtractionConfig:
-    args = build_arg_parser().parse_args(argv)
-    return sanity_check(ExtractionConfig.from_namespace(args))
+    cfg, _ = parse_batch_args(argv)
+    return cfg
 
 
 # ---------------------------------------------------------------------------

@@ -152,6 +152,29 @@ class BaseExtractor:
             self._prior_failed = faults.permanently_failed_videos(
                 self.config.output_path
             )
+        # --- content-addressed feature cache (extract/cache.py; ISSUE 17)
+        # Save runs only. Mesh sharding opts out: a per-process store
+        # probe diverges on per-host filesystems exactly like
+        # _already_done's local probe would, and every skip decision
+        # there must be collective.
+        self._feature_cache = None
+        self._cache_digest: Optional[str] = None
+        if (
+            getattr(self.config, "cache_dir", None)
+            and not external_call
+            and self.config.on_extraction in ("save_numpy", "save_pickle")
+            and getattr(self.config, "sharding", "queue") != "mesh"
+        ):
+            from video_features_tpu.extract.cache import (
+                FeatureCache,
+                config_digest,
+            )
+
+            self._feature_cache = FeatureCache(
+                self.config.cache_dir,
+                hash_mode=getattr(self.config, "cache_hash", "fast") or "fast",
+            )
+            self._cache_digest = config_digest(self.config)
 
     def feature_keys(self):
         """The keys a feats_dict will carry (used by --resume to probe for
@@ -343,6 +366,7 @@ class BaseExtractor:
                 self.manifest.record(
                     self._video_key(entry), "warning", stage="sink", message=w
                 )
+            self._cache_publish(entry)
 
     def _report_video_error(self, entry) -> None:
         """The per-video failure contract: print, continue, count the
@@ -390,6 +414,77 @@ class BaseExtractor:
     def _skip(self, entry, reason: str) -> None:
         self.manifest.record(self._video_key(entry), "skipped", message=reason)
         self.progress.update()
+
+    # --- content-addressed feature cache (extract/cache.py) ---------------
+    def _cacheable_entry(self, entry) -> bool:
+        """(rgb, flow-dir) pairs are uncacheable: the content hash covers
+        only the rgb file, so a changed flow dir would serve stale
+        features."""
+        return not (
+            isinstance(entry, (tuple, list)) and len(entry) > 1 and entry[1]
+        )
+
+    def _try_cache_hit(self, entry) -> bool:
+        """Content-addressed short-circuit before any decode work: when
+        the store holds this (content hash, config digest), materialize
+        the payloads onto the expected output paths and count the video
+        done (manifest note ``cache_hit``). Every cache-side failure —
+        unreadable input, corrupt entry, vanished payload — is a miss;
+        the real extraction path is always the fallback."""
+        if self._feature_cache is None or not self._cacheable_entry(entry):
+            return False
+        video = self._video_key(entry)
+        keys = self.feature_keys()
+        try:
+            chash = self._feature_cache.content_hash(video)
+        except OSError:
+            return False  # unreadable input: let the real path report it
+        cached = self._feature_cache.lookup(chash, self._cache_digest, keys)
+        if cached is not None:
+            try:
+                with self.telemetry.span("cache_hit", video=video):
+                    self._feature_cache.materialize(
+                        cached,
+                        self._feature_cache.dest_files(
+                            keys,
+                            video,
+                            self.output_path,
+                            self.config.on_extraction,
+                            self.config.output_direct,
+                        ),
+                    )
+            except OSError:
+                cached = None  # payload vanished mid-copy: treat as miss
+        if cached is None:
+            self.telemetry.metrics.inc(f"cache_miss.{self.feature_type}")
+            return False
+        self.telemetry.metrics.inc(f"cache_hit.{self.feature_type}")
+        self._on_success(entry, 1, note="cache_hit")
+        return True
+
+    def _cache_publish(self, entry) -> None:
+        """Populate the store from the files the sink just committed
+        atomically. Claim-by-rename semantics: losing to a concurrent
+        writer is a no-op, and any OSError leaves the store unchanged."""
+        if self._feature_cache is None or not self._cacheable_entry(entry):
+            return
+        video = self._video_key(entry)
+        try:
+            chash = self._feature_cache.content_hash(video)
+        except OSError:
+            return
+        dests = self._feature_cache.dest_files(
+            self.feature_keys(),
+            video,
+            self.output_path,
+            self.config.on_extraction,
+            self.config.output_direct,
+        )
+        if not all(os.path.exists(p) for p in dests.values()):
+            return
+        self._feature_cache.publish(
+            chash, self._cache_digest, dests, feature_type=self.feature_type
+        )
 
     def _preflight_entry(self, entry) -> None:
         """The vouching stage before a video's FIRST attempt
@@ -655,6 +750,8 @@ class BaseExtractor:
                 reason = self._resume_skip_reason(entry)
                 if reason is not None:
                     self._skip(entry, reason)
+                    continue
+                if self._try_cache_hit(entry):
                     continue
             wait = not_before - time.monotonic()
             if wait > 0:
@@ -1049,6 +1146,8 @@ class BaseExtractor:
                 reason = self._resume_skip_reason(entry)
                 if reason is not None:
                     self._skip(entry, reason)
+                    continue
+                if self._try_cache_hit(entry):
                     continue
                 pending.append((pos, idx, 1, pool.submit(prep, entry)))
                 if len(pending) > depth:

@@ -11,6 +11,14 @@ from __future__ import annotations
 from video_features_tpu.config import CLIP_FEATURE_TYPES, RESNET_FEATURE_TYPES, as_config
 
 
+def media_need_for(feature_type: str) -> str:
+    """What the preflight probe must find in this feature type's input
+    ('video' or 'audio') — derivable WITHOUT building the extractor, for
+    the admission paths (serve preflight, cache lookup) that must stay
+    build-free. Mirrors each extractor class's ``media_need``."""
+    return "audio" if feature_type in ("vggish", "vggish_torch") else "video"
+
+
 def build_extractor(config, external_call: bool = False):
     cfg = as_config(config)
     ft = cfg.feature_type

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import pathlib
 import pickle
+import shutil
 import threading
 import uuid
 from typing import Dict, List, Union
@@ -23,6 +24,28 @@ from video_features_tpu.runtime import faults
 
 META_KEYS = ("fps", "timestamps_ms")
 _SUFFIX = {"save_numpy": "npy", "save_pickle": "pkl"}
+
+
+def atomic_copy(src: str, dest: str) -> None:
+    """Copy ``src`` to ``dest`` through a uniquely-named tmp file +
+    ``os.replace`` — the same commit protocol as the feature saver
+    below, shared with the content-addressed cache (extract/cache.py)
+    so a kill mid-materialize can never leave a truncated output that
+    ``--resume`` (or a cache lookup) would then trust as complete."""
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    tmp = (
+        f"{dest}.{os.getpid()}-{threading.get_ident()}"
+        f"-{uuid.uuid4().hex[:8]}.tmp"
+    )
+    try:
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def output_file_name(name: str, key: str, on_extraction: str, output_direct: bool) -> str:

@@ -57,6 +57,12 @@ from video_features_tpu.runtime.faults import (
 _DECODER = "auto"  # 'auto' | 'cv2' | 'native'; set once from the config
 _DECODE_TIMEOUT: Optional[float] = None  # seconds per reader; set from the config
 _RESOURCE_CAPS: ResourceCaps = NO_CAPS  # --max_pixels etc.; set from the config
+# shared-decode frame cache (extract/plan.py::SharedFrameCache) for
+# multi-model fan-out: when installed, probe/extract_frames/
+# stream_frames serve decoded frames from it instead of opening a
+# reader — duck-typed (.acquire(path, decoder) -> clip or None) so io
+# keeps zero extract imports
+_FRAME_CACHE = None
 # BaseExtractor.__init__ sets the timeout, and the serve daemon builds
 # extractors from its dispatcher thread — rebinds must hold this lock
 _CONFIG_LOCK = threading.Lock()
@@ -120,6 +126,61 @@ def set_resource_caps(caps: Optional[ResourceCaps]) -> None:
     global _RESOURCE_CAPS
     with _CONFIG_LOCK:
         _RESOURCE_CAPS = caps or NO_CAPS
+
+
+def set_frame_cache(cache) -> None:
+    """Install (or, with None, remove) the shared-decode frame cache.
+    Scoped by the caller — extract/plan.py's fan-out context manager,
+    the serve daemon's lifetime — and module-global like the decoder
+    choice, because the samplers that benefit are constructed deep
+    inside extractors that don't thread config through."""
+    global _FRAME_CACHE
+    with _CONFIG_LOCK:
+        _FRAME_CACHE = cache
+
+
+def _cached_clip(path: str, decoder: Optional[str]):
+    """The cached decoded clip for ``path`` when a frame cache is
+    installed and admits it, else None (open a reader). Decode errors
+    from a cache population propagate unchanged — same failure
+    surface as a direct open."""
+    with _CONFIG_LOCK:
+        cache = _FRAME_CACHE
+    if cache is None:
+        return None
+    return cache.acquire(str(path), decoder)
+
+
+def _cached_fps_or_default(clip, path: str) -> float:
+    if clip.fps:
+        return clip.fps
+    _note(
+        "fps_defaulted",
+        f"fps metadata absent or ~zero; timestamps assume 25.0 fps: {path}",
+    )
+    return 25.0
+
+
+def _stream_from_cached(
+    clip, extraction_fps: Optional[float], path: str
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """:func:`_stream_from_reader`'s exact selection arithmetic replayed
+    over a cached frame list — same grid formula, same duplicate-on-
+    upsample behavior, same stop-at-decodable-end — so cached and
+    direct streams are bit-identical (tests/test_cache.py pins it)."""
+    src_fps = _cached_fps_or_default(clip, path)
+    frames = clip.frames
+    if extraction_fps is None:
+        for i, frame in enumerate(frames):
+            yield frame, i * 1000.0 / src_fps
+    else:
+        out_k = 0
+        while True:
+            target = int(round(out_k * src_fps / extraction_fps))
+            if target >= len(frames):
+                return
+            yield frames[target], out_k * 1000.0 / extraction_fps
+            out_k += 1
 
 
 def _resolve(decoder: Optional[str]) -> str:
@@ -314,6 +375,12 @@ class VideoMeta:
 
 
 def probe(path: str, decoder: Optional[str] = None) -> VideoMeta:
+    clip = _cached_clip(path, decoder)
+    if clip is not None:
+        return VideoMeta(
+            fps=clip.fps, frame_count=clip.frame_count,
+            width=clip.width, height=clip.height,
+        )
     with _Reader(path, decoder) as r:
         return VideoMeta(
             fps=r.fps, frame_count=r.frame_count, width=r.width, height=r.height
@@ -339,6 +406,11 @@ def read_frames_at_indices(
     need = sorted(set(int(i) for i in indices))
     if not need:
         return {}
+    clip = _cached_clip(path, decoder)
+    if clip is not None:
+        # the cached list is the sequential decode's output: indices
+        # past its end are absent, exactly like a grab() miss below
+        return {i: clip.frames[i] for i in need if i < len(clip.frames)}
     span = need[-1] + 1
 
     # crossover measured on the bench host: a seek costs ~13 sequential
@@ -449,6 +521,10 @@ def stream_frames(
     while still decoding sequentially (no random seeks — mp4 seeking is
     keyframe-inaccurate); skipped grid frames are grabbed, never converted.
     """
+    clip = _cached_clip(path, decoder)
+    if clip is not None:
+        yield from _stream_from_cached(clip, extraction_fps, str(path))
+        return
     with _Reader(path, decoder) as r:
         yield from _stream_from_reader(r, extraction_fps)
 
@@ -479,6 +555,13 @@ def read_all_frames_with_meta(
     report against, so a truncated stream fails with 'N of M declared
     frames decoded' instead of a bare N."""
     frames, stamps = [], []
+    clip = _cached_clip(path, decoder)
+    if clip is not None:
+        fps = extraction_fps or clip.fps or 25.0
+        for frame, ts in _stream_from_cached(clip, extraction_fps, str(path)):
+            frames.append(frame)
+            stamps.append(ts)
+        return frames, fps, stamps, clip.frame_count
     with _Reader(path, decoder) as r:
         declared = r.frame_count
         fps = extraction_fps or r.fps or 25.0

@@ -31,6 +31,7 @@ import signal
 import threading
 import time
 import traceback
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from video_features_tpu.config import (
@@ -288,6 +289,32 @@ class ServeDaemon:
 
         self._caps = ResourceCaps.from_config(self.cfg)
         self.pool = ExtractorPool(self.cfg, scfg.max_group_size, build=build)
+        # content-addressed feature cache (extract/cache.py): a repeat
+        # request for an already-extracted (content, config) pair goes
+        # terminal 'done' at admission — no queue, no decode, no chip.
+        # Misses populate the store through the pooled extractors' sink
+        # path (extract/base.py carries the same cache_dir).
+        self.cache: Any = None
+        self._cache_keys: Dict[str, tuple] = {}  # ft -> (digest, keys, out, mode, direct)
+        if getattr(self.cfg, "cache_dir", None):
+            from video_features_tpu.extract.cache import FeatureCache
+
+            self.cache = FeatureCache(
+                self.cfg.cache_dir,
+                hash_mode=getattr(self.cfg, "cache_hash", "fast") or "fast",
+            )
+        # shared-decode frame cache (extract/plan.py): a daemon serving
+        # >1 model decodes each clip once and fans the frames out to
+        # every resident extractor; installed for the daemon's lifetime,
+        # uninstalled in shutdown()
+        self._frame_cache: Any = None
+        if len(scfg.feature_types) > 1:
+            from video_features_tpu.extract.plan import cache_for
+            from video_features_tpu.io.video import set_frame_cache
+
+            self._frame_cache = cache_for(self.cfg, scfg.feature_types)
+            if self._frame_cache is not None:
+                set_frame_cache(self._frame_cache)
         self.batcher = AdmissionController(
             dispatch=self._dispatch_group,
             max_group_size=scfg.max_group_size,
@@ -334,7 +361,13 @@ class ServeDaemon:
         request is already recorded ``rejected``), or
         :class:`ModelUnavailable` (this feature type's breaker is open:
         HTTP -> 503 with Retry-After and a ``rejected`` record, spool ->
-        defer the file untouched)."""
+        defer the file untouched).
+
+        A payload carrying ``feature_types`` (a LIST) is the multi-model
+        fan-out form: one video, several models, one decode (see
+        :meth:`_submit_fanout`)."""
+        if isinstance(payload, dict) and "feature_types" in payload:
+            return self._submit_fanout(payload, source)
         req = parse_request(payload, source)
         # the admission span covers validation + preflight probe +
         # breaker gate + queue admit; tracker.admit's request span opens
@@ -351,6 +384,13 @@ class ServeDaemon:
             if not os.path.exists(req.video_path):
                 raise BadRequest(f"video_path does not exist: {req.video_path}")
             self._preflight(req)
+            files = self._cache_lookup(req)
+            if files is not None:
+                # content-addressed hit: the outputs are already on disk
+                # under this exact config — the request goes terminal at
+                # admission, skipping queue/scheduler/chip entirely
+                self.tracker.admit(req)
+                return self.tracker.finish(req, "done", features=files)
             faults.fire("admission")
             breaker = self._breaker(req.feature_type)
             if not breaker.allow_request():
@@ -386,15 +426,138 @@ class ServeDaemon:
         spool -> ``.bad`` + ``.why`` quarantine)."""
         if getattr(self.cfg, "preflight", "off") != "on":
             return
+        from video_features_tpu.extract.registry import media_need_for
         from video_features_tpu.io import probe as probe_mod
 
-        need = "audio" if req.feature_type in ("vggish", "vggish_torch") else "video"
+        need = media_need_for(req.feature_type)
         report = probe_mod.preflight(req.video_path, need=need, caps=self._caps)
         if report.verdict != "reject":
             return
         reason = f"invalid media: {report.reason}"
         rec = self.tracker.reject(req, reason)
         raise InvalidMedia(reason, record=rec)
+
+    # -- multi-model fan-out ----------------------------------------------
+
+    def _submit_fanout(self, payload: Dict[str, Any], source: str) -> Dict[str, Any]:
+        """One video, several models: expand ``feature_types`` into one
+        sub-request per model (ids ``<base>.<feature_type>``) and submit
+        each through the normal admission path. The daemon's shared-
+        decode frame cache makes the expansion decode the clip ONCE; the
+        content hash is memoized, so N models hash the bytes once too.
+
+        Sub-requests already tracked under their derived id are returned
+        as-is (idempotent: a spool file re-polled after a partial
+        QueueFull admits only the missing members). QueueFull and
+        InvalidMedia propagate — the caller's backpressure/quarantine
+        contract is per-payload; already-admitted members stay admitted
+        and the duplicate tolerance absorbs the re-submit."""
+        fts = payload.get("feature_types")
+        if (
+            not isinstance(fts, list)
+            or not fts
+            or not all(isinstance(f, str) and f for f in fts)
+        ):
+            raise BadRequest(
+                "bad 'feature_types': expected a non-empty list of strings"
+            )
+        if "feature_type" in payload:
+            raise BadRequest(
+                "pass either 'feature_type' or 'feature_types', not both"
+            )
+        fts = list(dict.fromkeys(fts))
+        unserved = [f for f in fts if f not in self.scfg.feature_types]
+        if unserved:
+            # validate the WHOLE list before admitting anything: a fan-out
+            # must not half-run because one member named a missing model
+            raise BadRequest(
+                f"feature_type(s) {', '.join(map(repr, unserved))} not served "
+                f"(serving: {', '.join(self.scfg.feature_types)})"
+            )
+        base = {k: v for k, v in payload.items() if k != "feature_types"}
+        base_id = base.pop("id", None) or uuid.uuid4().hex[:12]
+        subs: Dict[str, Dict[str, Any]] = {}
+        for ft in fts:
+            sub_id = f"{base_id}.{ft.replace('/', '-')}"
+            existing = self.tracker.get(sub_id)
+            if existing is not None:
+                subs[ft] = existing
+                continue
+            sub = dict(base)
+            sub["feature_type"] = ft
+            sub["id"] = sub_id
+            subs[ft] = self.submit(sub, source)
+        states = [r.get("state") for r in subs.values()]
+        return {
+            "id": base_id,
+            "fanout": True,
+            "state": "done" if all(s == "done" for s in states) else "queued",
+            "video_path": payload.get("video_path"),
+            "feature_types": fts,
+            "requests": subs,
+        }
+
+    # -- content-addressed cache ------------------------------------------
+
+    def _cache_key_for(self, feature_type: str) -> tuple:
+        """(config digest, feature keys, output path, on_extraction,
+        output_direct) for one served model — derived from the SAME
+        serving config the pool builds extractors from, WITHOUT building
+        the model (admission must never pay a weights load to answer a
+        lookup). Memoized: the config is immutable for the daemon's
+        lifetime."""
+        with self._lock:
+            got = self._cache_keys.get(feature_type)
+        if got is not None:
+            return got
+        from video_features_tpu.extract.cache import config_digest, feature_keys_for
+
+        cfg = self.pool._serving_config(feature_type)
+        out_path = (
+            cfg.output_path
+            if cfg.output_direct
+            else os.path.join(cfg.output_path, feature_type)
+        )
+        got = (
+            config_digest(cfg),
+            feature_keys_for(cfg),
+            out_path,
+            cfg.on_extraction,
+            cfg.output_direct,
+        )
+        with self._lock:
+            self._cache_keys.setdefault(feature_type, got)
+        return got
+
+    def _cache_lookup(self, req: ExtractionRequest) -> Optional[List[str]]:
+        """Admission-time content-addressed lookup: the materialized
+        output files on a hit, None on a miss (or with caching off). Any
+        cache-side failure is a miss — the normal dispatch path is
+        always the fallback, never a wrong answer."""
+        if self.cache is None:
+            return None
+        ft = req.feature_type
+        try:
+            chash = self.cache.content_hash(req.video_path)
+        except OSError:
+            return None
+        digest, keys, out_path, on_ext, direct = self._cache_key_for(ft)
+        cached = self.cache.lookup(chash, digest, keys)
+        if cached is not None:
+            try:
+                files = self.cache.materialize(
+                    cached,
+                    self.cache.dest_files(
+                        keys, req.video_path, out_path, on_ext, direct
+                    ),
+                )
+            except OSError:
+                cached = None  # payload vanished mid-copy: miss
+            else:
+                self.telemetry.metrics.inc(f"cache_hit.{ft}")
+                return files
+        self.telemetry.metrics.inc(f"cache_miss.{ft}")
+        return None
 
     def _dispatch_group(self, key: Key, requests: List[ExtractionRequest]) -> None:
         """One coalesced group -> one resident-extractor run over the
@@ -767,7 +930,29 @@ class ServeDaemon:
         out["cost_model"] = self.cost_model.snapshot()
         out["metrics"] = self.telemetry.metrics.snapshot()
         out["ledger"] = self.ledger.snapshot()
+        hits, misses = self._cache_counts(out["metrics"])
+        out["cache"] = {
+            "enabled": self.cache is not None,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / (hits + misses), 4) if hits + misses else 0.0,
+        }
+        if self._frame_cache is not None:
+            out["cache"]["frame_cache"] = self._frame_cache.stats()
         return out
+
+    @staticmethod
+    def _cache_counts(snapshot: Dict[str, Any]) -> Tuple[int, int]:
+        """(hits, misses) summed over feature types from a metrics
+        snapshot's ``cache_hit.<ft>`` / ``cache_miss.<ft>`` counters."""
+        counters = snapshot.get("counters", {})
+        hits = int(sum(
+            v for k, v in counters.items() if k.startswith("cache_hit.")
+        ))
+        misses = int(sum(
+            v for k, v in counters.items() if k.startswith("cache_miss.")
+        ))
+        return hits, misses
 
     def metrics_text(self) -> str:
         """The /metrics body: Prometheus text exposition (format 0.0.4)
@@ -861,6 +1046,13 @@ class ServeDaemon:
             f"inflight={inflight} completed/s={rate:.2f} "
             f"miss_rate={self.slo.miss_rate():.1%}"
         )
+        if self.cache is not None:
+            hits, misses = self._cache_counts(snap)
+            total = hits + misses
+            line += (
+                f" cache_hit_rate={hits / total:.1%}" if total
+                else " cache_hit_rate=n/a"
+            )
         if open_breakers:
             line += " breakers_open=" + ",".join(open_breakers)
         headroom = snap["gauges"].get("device_mem_headroom_bytes")
@@ -899,6 +1091,13 @@ class ServeDaemon:
                     message="daemon shutdown before dispatch; resubmit to retry",
                 )
         self.pool.close()
+        if self._frame_cache is not None:
+            # uninstall the shared-decode hook: a later daemon (or batch
+            # run) in this process must not replay this daemon's frames
+            from video_features_tpu.io.video import set_frame_cache
+
+            set_frame_cache(None)
+            self._frame_cache = None
         try:
             # persist the learned service times next to the compile
             # cache so the next daemon's edf-cost scheduler starts warm

@@ -9,6 +9,13 @@ framework; anything fancier belongs behind a real proxy):
   (recorded nowhere — it never had an identity), 503 + Retry-After when
   the bounded admission queue is full OR this feature type's circuit
   breaker is open (recorded ``rejected``; the client owns the retry).
+  With ``--cache_dir``, a content-addressed cache hit returns 202 with
+  the record already terminal ``done`` (features listed) — no dispatch.
+  The multi-model form replaces ``feature_type`` with ``"feature_types":
+  [...]`` (a LIST): one sub-request per model (ids ``<base>.<model>``),
+  the video decoded ONCE for all of them, 202 + an aggregate body
+  ``{"fanout": true, "requests": {<model>: <record>, ...}}`` whose
+  members are polled individually via ``GET /v1/requests/<sub-id>``.
 - ``GET /v1/requests/<id>`` — the lifecycle record (memory, falling back
   to the durable result JSON); 404 for unknown ids.
 - ``DELETE /v1/requests/<id>`` — cancel: 200 + the terminal record when

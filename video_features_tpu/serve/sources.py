@@ -3,7 +3,10 @@
 Protocol (documented in docs/serving.md): a producer writes a request as
 ``<spool>/<name>.json`` — atomically, via write-to-temp + rename into
 the directory, exactly like the sinks in io/ — with the same schema as
-the HTTP body. Scheduling hints can ride in the payload
+the HTTP body (including the multi-model ``feature_types`` LIST form:
+one decode fanned out to several models; a re-polled fan-out file only
+admits the members the previous attempt could not, the rest resolve as
+duplicates of already-tracked sub-requests). Scheduling hints can ride in the payload
 (``priority``/``deadline_ms``) or, for producers that only control the
 filename, in the name itself: ``<base>.pN.json`` sets priority N and
 ``<base>.dMS.json`` sets deadline_ms MS (combined: ``clip.p7.d500.json``

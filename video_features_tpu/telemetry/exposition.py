@@ -160,6 +160,19 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 "--frame_delta_threshold (features filled by "
                 "copy-forward; see docs/tpu.md).",
             ).add(None, value)
+        elif name.startswith("cache_hit."):
+            fam(
+                f"{METRIC_PREFIX}cache_hit_total", "counter",
+                "Content-addressed feature cache hits per feature type "
+                "(request served from the store without decode or "
+                "dispatch; see docs/serving.md).",
+            ).add({"feature_type": name[len("cache_hit."):]}, value)
+        elif name.startswith("cache_miss."):
+            fam(
+                f"{METRIC_PREFIX}cache_miss_total", "counter",
+                "Content-addressed feature cache misses per feature type "
+                "(extraction ran and populated the store).",
+            ).add({"feature_type": name[len("cache_miss."):]}, value)
         else:
             fam(
                 f"{METRIC_PREFIX}{sanitize_metric_name(name)}_total", "counter",
