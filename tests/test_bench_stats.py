@@ -172,6 +172,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
         "ledger_overhead": {"ledger_overhead_us_per_video": 16.0},
         "ingest_overlap": {"ingest_overlap_efficiency": 0.02},
         "cache_serving": {"cache_hit_speedup": 400.0},
+        "serve_preemption": {"serve_preempt_on_miss_rate": 0.0},
     }
     monkeypatch.setattr(
         bench, "_spawn_sub",
@@ -209,6 +210,7 @@ def test_main_emits_incremental_parseable_artifacts(monkeypatch, capsys):
     assert final["extra"]["ledger_overhead_us_per_video"] == 16.0
     assert final["extra"]["ingest_overlap_efficiency"] == 0.02
     assert final["extra"]["cache_hit_speedup"] == 400.0
+    assert final["extra"]["serve_preempt_on_miss_rate"] == 0.0
     i3d_base = bench.MEASURED_BASELINES["i3d_raft_torch_cpu_vps"]
     assert final["extra"]["i3d_raft_vs_torch_cpu"] == pytest.approx(
         0.2 / i3d_base, abs=0.1
@@ -254,6 +256,8 @@ def test_main_dead_backend_still_emits_host_artifact(monkeypatch, capsys):
             return {"ingest_overlap_efficiency": 0.02}
         if name == "cache_serving":  # cache + fan-out bench, CPU-pinned
             return {"cache_hit_speedup": 400.0}
+        if name == "serve_preemption":  # fleet A/B + steal drill, pure host
+            return {"serve_preempt_on_miss_rate": 0.0}
         raise AssertionError(f"part {name} ran despite dead backend")
 
     monkeypatch.setattr(bench, "_spawn_sub", boom)

@@ -1,0 +1,928 @@
+"""Fleet robustness (ISSUE 18): HBM-aware preemption, multi-replica
+work-stealing, and chaos-tested recovery.
+
+Deterministic by construction, like the rest of the serve suite: the
+preemptor is driven with injected ledgers/clocks/pools (no device), the
+lease protocol with a fake-clock SpoolWatcher whose staleness is
+backdated via ``os.utime`` (mtimes are the one clock replicas share),
+and the chaos drill SIGKILLs a jax-free subprocess replica through the
+``replica_kill`` fault stage — no sleep-based races anywhere except the
+bounded subprocess waits.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from test_faults import ToyExtractor, _cfg, toy_videos  # noqa: F401
+from test_serve import FakeClock, ServeToy, serve_videos  # noqa: F401
+
+from video_features_tpu.config import parse_serve_args
+from video_features_tpu.runtime import faults
+from video_features_tpu.runtime.telemetry import MetricsRegistry
+from video_features_tpu.serve.batcher import QueueFull
+from video_features_tpu.serve.costmodel import ServiceTimeModel
+from video_features_tpu.serve.daemon import ServeDaemon
+from video_features_tpu.serve.lifecycle import (
+    ExtractionRequest,
+    ReplicaRegistry,
+    RequestTracker,
+    requests_root,
+)
+from video_features_tpu.serve.preemptor import Preemptor, simulate_overcommit
+from video_features_tpu.serve.sources import SpoolWatcher
+from video_features_tpu.serve.supervisor import CircuitBreaker, ModelUnavailable
+from video_features_tpu.telemetry.exposition import (
+    families_from_snapshot,
+    render_families,
+    validate_exposition,
+)
+from video_features_tpu.telemetry.ledger import CostLedger
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_fault_state():
+    yield
+    faults.install_injector(None)
+
+
+# --- preemptor units (injected ledger/pool/clock, no daemon) -----------------
+
+
+class FakePool:
+    def __init__(self, residents=(), built_at=None):
+        self._resident = set(residents)
+        self.built_at = dict(built_at or {})
+        self.evicted = []
+
+    def feature_types(self):
+        return set(self._resident)
+
+    def evict(self, ft):
+        self._resident.discard(ft)
+        self.built_at.pop(ft, None)
+        self.evicted.append(ft)
+
+
+class EventLog:
+    def __init__(self):
+        self.log = []
+
+    def event(self, name, **fields):
+        self.log.append((name, fields))
+
+    def names(self):
+        return [n for n, _ in self.log]
+
+
+def _ledger(entries):
+    """A CostLedger holding one tpu entry per (model, resident_bytes)."""
+    led = CostLedger(path=None)
+    for model, resident in entries.items():
+        led.record(
+            model, "fam", "64x48", "queue", "tpu",
+            {"memory": {"argument_bytes": int(resident)}},
+        )
+    return led
+
+
+def _preemptor(
+    ledger,
+    pool,
+    breakers,
+    clock,
+    headroom=None,
+    queued=None,
+    budget=0,
+    cooldown_s=0.0,
+    min_residency_s=0.0,
+    metrics=None,
+    manifest=None,
+):
+    return Preemptor(
+        ledger=ledger,
+        cost_model=ServiceTimeModel(path=None),
+        pool=pool,
+        breaker_for=lambda ft: breakers.setdefault(
+            ft, CircuitBreaker(clock=clock)
+        ),
+        headroom_fn=(lambda: headroom) if headroom is not None else None,
+        queued_fn=(lambda: queued) if queued is not None else None,
+        hbm_budget_bytes=budget,
+        cooldown_s=cooldown_s,
+        min_residency_s=min_residency_s,
+        clock=clock,
+        metrics=metrics,
+        manifest=manifest,
+    )
+
+
+def test_preemptor_unknown_without_projection_never_crashes():
+    """CPU backends land here: the ledger has no HBM entries, so the
+    verdict is 'unknown' and preemption stays entirely out of the way."""
+    clk = FakeClock()
+    led = CostLedger(path=None)  # empty: a pure-CPU run projects nothing
+    led.record("m_cpu", "fam", "64x48", "queue", "cpu",
+               {"memory": {"argument_bytes": 10**9}})  # cpu: still nothing
+    p = _preemptor(led, FakePool({"a"}), {}, clk, headroom=0)
+    assert p.check("m_cpu") == ("unknown", 0, None)
+    assert p.ensure_room("m_cpu") is None
+    assert p.value_score("m_cpu") >= 1.0  # ranking never crashes either
+
+
+def test_preemptor_unknown_without_headroom_signal():
+    clk = FakeClock()
+    led = _ledger({"b": 500})
+    p = _preemptor(led, FakePool({"a"}), {}, clk)  # no headroom_fn, no budget
+    verdict, needed, available = p.check("b")
+    assert (verdict, needed, available) == ("unknown", 500, None)
+    assert p.ensure_room("b") is None
+
+
+def test_preemptor_resident_always_fits():
+    clk = FakeClock()
+    p = _preemptor(_ledger({"a": 500}), FakePool({"a"}), {}, clk, headroom=0)
+    assert p.check("a")[0] == "fits"
+
+
+def test_preemptor_evicts_lowest_value_and_trips_breaker(tmp_path):
+    clk = FakeClock(100.0)
+    led = _ledger({"a": 400, "b": 400, "c": 500})
+    pool = FakePool({"a", "b"}, built_at={"a": 0.0, "b": 0.0})
+    breakers = {}
+    metrics = MetricsRegistry()
+    events = EventLog()
+    # b has queued work (priority 5); a is idle -> a is the victim
+    queued = {"b": {"count": 3, "max_priority": 5, "buckets": ["64x48"]}}
+    p = _preemptor(led, pool, breakers, clk, headroom=200, queued=queued,
+                   metrics=metrics, manifest=events)
+    assert p.check("c") == ("overcommit", 500, 200)
+    plan = p.ensure_room("c")
+    assert plan is not None and plan.victims == ["a"]
+    assert pool.evicted == ["a"] and "b" in pool.feature_types()
+    assert breakers["a"].state() == "open"  # tripped, not just evicted
+    assert metrics.snapshot()["counters"]["preemptions.a"] == 1
+    assert events.names() == ["preempted"]
+    assert events.log[0][1]["beneficiary"] == "c"
+
+
+def test_preemptor_equal_value_tie_breaks_by_name():
+    clk = FakeClock(100.0)
+    led = _ledger({"x": 400, "m": 400, "z": 400, "new": 300})
+    pool = FakePool({"z", "x", "m"}, built_at={})
+    p = _preemptor(led, pool, {}, clk, headroom=0)
+    # all three residents are idle/cold: identical score 1.0 each -> the
+    # victim list is lexicographic and stable across repeated ranking
+    assert p.value_score("x") == p.value_score("m") == p.value_score("z")
+    plan = p.ensure_room("new")
+    assert plan is not None and plan.victims == ["m"]
+
+
+def test_preemptor_min_residency_guard():
+    clk = FakeClock(100.0)
+    led = _ledger({"a": 400, "b": 400})
+    pool = FakePool({"a"}, built_at={"a": 95.0})  # built 5s ago
+    p = _preemptor(led, pool, {}, clk, headroom=0, min_residency_s=60.0)
+    assert p.ensure_room("b") is None  # a is too young to thrash
+    assert pool.evicted == []
+    clk.t = 200.0  # now resident 105s: eligible
+    assert p.ensure_room("b") is not None
+    assert pool.evicted == ["a"]
+
+
+def test_preemptor_cooldown_hysteresis():
+    clk = FakeClock(0.0)
+    led = _ledger({"a": 400, "b": 400, "c": 400})
+    pool = FakePool({"a", "b"}, built_at={})
+    p = _preemptor(led, pool, {}, clk, headroom=0, cooldown_s=30.0)
+    assert p.ensure_room("c") is not None
+    clk.t = 10.0  # within the cooldown: a second burst cannot evict
+    assert p.ensure_room("c") is None and pool.evicted == ["a"]
+    clk.t = 31.0
+    assert p.ensure_room("c") is not None
+    assert pool.evicted == ["a", "b"]
+
+
+def test_preemptor_rollback_restores_preempted_breakers():
+    clk = FakeClock()
+    led = _ledger({"a": 400, "b": 400})
+    pool = FakePool({"a"}, built_at={})
+    breakers = {}
+    events = EventLog()
+    p = _preemptor(led, pool, breakers, clk, headroom=0, manifest=events)
+    plan = p.ensure_room("b")
+    assert plan is not None and breakers["a"].state() == "open"
+    p.rollback(plan)
+    assert breakers["a"].state() == "closed"  # serves again, no cooldown
+    assert events.names() == ["preempted", "preemption_rollback"]
+
+
+def test_preemptor_rejects_when_full_sweep_cannot_fit():
+    clk = FakeClock()
+    led = _ledger({"a": 100, "big": 10_000})
+    pool = FakePool({"a"}, built_at={})
+    breakers = {}
+    p = _preemptor(led, pool, breakers, clk, headroom=50)
+    assert p.ensure_room("big") is None  # 50 + 100 << 10_000: reject
+    assert pool.evicted == [] and pool.feature_types() == {"a"}
+    assert not breakers or breakers["a"].state() == "closed"
+
+
+def test_hbm_squeeze_fault_collapses_headroom():
+    clk = FakeClock()
+    led = _ledger({"b": 10})
+    p = _preemptor(led, FakePool({"a"}), {}, clk, headroom=10**12)
+    assert p.check("b")[0] == "fits"
+    faults.install_injector(["hbm_squeeze:error:1"])
+    assert p.check("b") == ("overcommit", 10, 0)  # squeezed: headroom 0
+
+
+def test_simulate_overcommit_preemption_lowers_miss_rate():
+    """The pinned A/B the serve_preemption bench runs: same burst, with
+    and without the preemptor — ON must strictly beat OFF on misses."""
+    clk = FakeClock()
+    led = _ledger({"a": 400, "b": 500})
+    bursts = [("a", 4), ("b", 6)]
+
+    def run(preemptor):
+        pool = FakePool({"a"}, built_at={})
+        p = None
+        if preemptor:
+            p = _preemptor(led, pool, {}, clk, headroom=100)
+        return simulate_overcommit(
+            p, bursts, resident_fits=lambda ft: ft == "a",
+            service_s=1.0, deadline_s=2.5, rewarm_s=0.5,
+        )
+
+    off = run(False)
+    on = run(True)
+    assert [r["met"] for r in off] == [True] * 4 + [False] * 6
+    assert all(r["met"] for r in on)
+    # first preempted group pays the re-warm toll, the rest do not
+    b_latencies = [r["latency_s"] for r in on if r["feature_type"] == "b"]
+    assert b_latencies == [1.5] * 6  # one fused group: all share the toll
+    off_miss = sum(not r["met"] for r in off) / len(off)
+    on_miss = sum(not r["met"] for r in on) / len(on)
+    assert on_miss < off_miss
+
+
+# --- daemon integration: the admission HBM gate ------------------------------
+
+
+def _fleet_daemon(tmp_path, build=None, clock=None, **flags):
+    argv = [
+        "--feature_types", "resnet18", "resnet34",
+        "--output_path", str(tmp_path / "out"),
+        "--tmp_path", str(tmp_path / "tmp"),
+        "--allow_random_init", "--cpu",
+        "--heartbeat_s", "0",
+    ]
+    for k, v in flags.items():
+        argv += [f"--{k}"] + ([str(v)] if v is not True else [])
+    scfg = parse_serve_args(argv)
+
+    class Toy(ServeToy):
+        built = 0
+
+    kw = {"build": build or Toy}
+    if clock is not None:
+        kw["clock"] = clock
+    return ServeDaemon(scfg, **kw), Toy
+
+
+def _drain_inline(d):
+    for g in d.batcher.take_ready(now=float("inf")):
+        d.batcher._run_group(g)
+
+
+def _events(d):
+    return [
+        r for r in faults.iter_manifest_records(requests_root(d.cfg.output_path))
+        if r.get("event")
+    ]
+
+
+def test_daemon_gate_preempts_resident_for_overcommit_burst(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(
+        tmp_path, preempt="on", hbm_budget_bytes=1000,
+        preempt_min_residency_s=0, preempt_cooldown_s=0,
+    )
+    try:
+        # price both models as if a chip had compiled them (CPU runs
+        # record platform=cpu entries, which project nothing)
+        d.ledger.record("resnet18", "resnet", "64x48", "queue", "tpu",
+                        {"memory": {"argument_bytes": 800}})
+        d.ledger.record("resnet34", "resnet", "64x48", "queue", "tpu",
+                        {"memory": {"argument_bytes": 500}})
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "w1"}, source="local")
+        _drain_inline(d)
+        assert set(d.pool.feature_types()) == {"resnet18"}
+        # resnet34 needs 500 beside resnet18's 800 in a 1000 budget:
+        # overcommit -> the idle resident is preempted, not the burst 503d
+        d.submit({"feature_type": "resnet34", "video_path": serve_videos[1],
+                  "id": "b1"}, source="local")
+        _drain_inline(d)
+        assert d.tracker.get("b1")["state"] == "done"
+        assert "resnet18" not in d.pool.feature_types()
+        assert d._breaker("resnet18").state() == "open"  # tripped teardown
+        counters = d.telemetry.metrics.snapshot()["counters"]
+        assert counters["preemptions.resnet18"] == 1
+        assert [e["event"] for e in _events(d) if e["event"] == "preempted"] \
+            == ["preempted"]
+        assert d.status()["preemptor"]["preemptions"] == 1
+    finally:
+        d.shutdown()
+
+
+def test_daemon_gate_rejects_when_residents_protected(tmp_path, serve_videos):
+    """Min-residency guard at the daemon level: a just-built resident is
+    not preemptible, so the burst is refused with the ledger numbers in
+    the error and a durable rejected record."""
+    d, _ = _fleet_daemon(
+        tmp_path, preempt="on", hbm_budget_bytes=1000,
+        preempt_min_residency_s=3600, preempt_cooldown_s=0,
+    )
+    try:
+        d.ledger.record("resnet18", "resnet", "64x48", "queue", "tpu",
+                        {"memory": {"argument_bytes": 800}})
+        d.ledger.record("resnet34", "resnet", "64x48", "queue", "tpu",
+                        {"memory": {"argument_bytes": 500}})
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "w1"}, source="local")
+        _drain_inline(d)
+        with pytest.raises(ModelUnavailable) as ei:
+            d.submit({"feature_type": "resnet34",
+                      "video_path": serve_videos[1], "id": "b1"},
+                     source="local")
+        assert "cannot fit" in str(ei.value)
+        rec = d.tracker.get("b1")
+        assert rec["state"] == "rejected" and "cannot fit" in rec["message"]
+        assert set(d.pool.feature_types()) == {"resnet18"}  # untouched
+    finally:
+        d.shutdown()
+
+
+def test_daemon_preemption_rollback_on_beneficiary_build_failure(
+    tmp_path, serve_videos
+):
+    """The gamble fails: the beneficiary's build crashes after the victim
+    was sacrificed — the victim's breaker is force-closed so the
+    pre-preemption resident set rebuilds on demand."""
+
+    class Toy(ServeToy):
+        built = 0
+
+    def build(cfg):
+        if cfg.feature_type == "resnet34":
+            raise RuntimeError("RESOURCE_EXHAUSTED: hbm")
+        return Toy(cfg)
+
+    d, _ = _fleet_daemon(
+        tmp_path, build=build, preempt="on", hbm_budget_bytes=1000,
+        preempt_min_residency_s=0, preempt_cooldown_s=0,
+    )
+    try:
+        d.ledger.record("resnet18", "resnet", "64x48", "queue", "tpu",
+                        {"memory": {"argument_bytes": 800}})
+        d.ledger.record("resnet34", "resnet", "64x48", "queue", "tpu",
+                        {"memory": {"argument_bytes": 500}})
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "w1"}, source="local")
+        _drain_inline(d)
+        d.submit({"feature_type": "resnet34", "video_path": serve_videos[1],
+                  "id": "b1"}, source="local")
+        _drain_inline(d)  # build crashes -> rollback
+        assert d.tracker.get("b1")["state"] == "failed"
+        assert d._breaker("resnet18").state() == "closed"  # handed back
+        assert [e["event"] for e in _events(d)
+                if e["event"] == "preemption_rollback"]
+        # the victim serves again immediately: rebuild on demand
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[2],
+                  "id": "w2"}, source="local")
+        _drain_inline(d)
+        assert d.tracker.get("w2")["state"] == "done"
+    finally:
+        d.shutdown()
+
+
+# --- breaker probe-slot leak (ISSUE 18 satellite bugfix) ---------------------
+
+
+def test_half_open_probe_verdict_lands_before_tracker_writes(tmp_path, serve_videos):
+    """Regression: a re-warm failure whose tracker.finish ALSO raises
+    (fault injection, full disk) used to leave the half-open probe slot
+    claimed forever — this model 503d until restart. The verdict must
+    land first: the breaker re-opens (would-refire) and a later probe
+    slot is claimable."""
+    clk = FakeClock()
+    fail = {"build": False}
+
+    class Toy(ServeToy):
+        built = 0
+
+    def build(cfg):
+        if fail["build"]:
+            raise RuntimeError("weights host unreachable")
+        return Toy(cfg)
+
+    d, _ = _fleet_daemon(
+        tmp_path, build=build, clock=clk,
+        breaker_threshold=1, breaker_cooldown_s=30,
+    )
+    try:
+        fail["build"] = True
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "r1"}, source="local")
+        _drain_inline(d)  # build fails -> breaker opens (threshold 1)
+        breaker = d._breaker("resnet18")
+        assert breaker.state() == "open"
+        clk.t += 31.0
+        assert breaker.state() == "half_open"
+        real_finish = d.tracker.finish
+
+        def finish_raises(*a, **k):
+            raise RuntimeError("tracker write failed")
+
+        d.tracker.finish = finish_raises
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[1],
+                  "id": "r2"}, source="local")
+        try:
+            _drain_inline(d)  # probe build fails AND the tracker raises
+        except RuntimeError:
+            pass
+        finally:
+            d.tracker.finish = real_finish
+        # the verdict landed before the tracker crash: re-opened, and the
+        # slot is NOT leaked — after the cooldown the next group can probe
+        assert breaker.state() == "open"
+        clk.t += 31.0
+        assert breaker.state() == "half_open"
+        assert breaker.try_probe() is True
+        breaker.record_ignored()  # release the slot we just claimed
+    finally:
+        d.shutdown()
+
+
+# --- hit-rate-aware shedding (ISSUE 18 satellite) ----------------------------
+
+
+def test_shed_likely_cache_miss_when_saturated(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(
+        tmp_path, shed_watermark=0.5, max_queue=2,
+        cache_dir=str(tmp_path / "cache"),
+    )
+    try:
+        # a hot cache: hits dominate, so shedding known misses preserves
+        # admission room for the ~ms hit path
+        d.telemetry.metrics.inc("cache_hit.resnet18", 25)
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "q1"}, source="local")  # queued, not drained
+        assert d.batcher.depth() == 1  # >= 0.5 * max_queue
+        with pytest.raises(QueueFull) as ei:
+            d.submit({"feature_type": "resnet18",
+                      "video_path": serve_videos[1], "id": "q2"},
+                     source="local")
+        assert "missed the feature cache" in str(ei.value)
+        rec = d.tracker.get("q2")
+        assert rec["state"] == "rejected"
+        counters = d.telemetry.metrics.snapshot()["counters"]
+        assert counters["requests_shed.likely_cache_miss"] == 1
+        # the first request still drains normally
+        _drain_inline(d)
+        assert d.tracker.get("q1")["state"] == "done"
+    finally:
+        d.shutdown()
+
+
+def test_shed_disabled_on_cold_or_miss_heavy_cache(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(
+        tmp_path, shed_watermark=0.5, max_queue=4,
+        cache_dir=str(tmp_path / "cache"),
+    )
+    try:
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "q1"}, source="local")
+        assert d.batcher.depth() >= 0.5 * 4 / 2  # saturation irrelevant:
+        # the cache is cold (< 20 lookups), so nothing is shed
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[1],
+                  "id": "q2"}, source="local")
+        assert d.tracker.get("q2")["state"] == "queued"
+        _drain_inline(d)
+    finally:
+        d.shutdown()
+
+
+# --- exposition: the fleet metric families -----------------------------------
+
+
+def test_exposition_fleet_series():
+    m = MetricsRegistry()
+    m.inc("requests_shed.likely_cache_miss", 3)
+    m.inc("requests_shed.queue_full", 2)
+    m.inc("preemptions.resnet18", 1)
+    m.inc("lease_steals.resnet18", 4)
+    m.inc("lease_expired", 4)
+    m.set_gauge("replica_up.rA", 1)
+    m.set_gauge("replica_up.rB", 0)
+    text = render_families(families_from_snapshot(m.snapshot()))
+    assert validate_exposition(text) == []
+    assert ('vft_requests_total{shed_reason="likely_cache_miss",'
+            'state="shed"} 3') in text
+    assert 'vft_requests_total{shed_reason="queue_full",state="shed"} 2' in text
+    assert 'vft_preemptions_total{feature_type="resnet18"} 1' in text
+    assert 'vft_lease_steals_total{feature_type="resnet18"} 4' in text
+    assert "vft_lease_expired_total 4" in text
+    assert 'vft_replica_up{replica="rA"} 1' in text
+    assert 'vft_replica_up{replica="rB"} 0' in text
+
+
+# --- replica registry --------------------------------------------------------
+
+
+def test_replica_registry_beat_live_retire(tmp_path):
+    out = str(tmp_path / "out")
+    ra = ReplicaRegistry(out, "rA")
+    rb = ReplicaRegistry(out, "rB")
+    ra.beat()
+    rb.beat()
+    assert ra.live(5.0) == {"rA", "rB"}
+    # a stale heartbeat ages out of the live set (backdated mtime)
+    old = time.time() - 100
+    os.utime(rb.path, (old, old))
+    assert ra.live(5.0) == {"rA"}
+    # timeout <= 0: liveness is never inferred, everyone counts as live
+    assert ra.live(0.0) == {"rA", "rB"}
+    rb.retire()
+    assert ra.live(0.0) == {"rA"}
+
+
+# --- spool leases + work stealing --------------------------------------------
+
+
+def _spool_file(spool, name, ft="resnet18", video="/v.mp4", rid=None):
+    os.makedirs(spool, exist_ok=True)
+    payload = {"feature_type": ft, "video_path": video}
+    if rid:
+        payload["id"] = rid
+    tmp = os.path.join(spool, f".{name}.tmp")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, os.path.join(spool, name))
+    return os.path.join(spool, name)
+
+
+def test_lease_held_until_terminal_then_released(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(tmp_path)
+    spool = str(tmp_path / "spool")
+    try:
+        w = SpoolWatcher(
+            d, spool, clock=FakeClock(), replica_id="rA",
+            lease_timeout_s=5.0,
+            registry=ReplicaRegistry(d.cfg.output_path, "rA"),
+        )
+        _spool_file(spool, "s1.json", video=serve_videos[0], rid="s1")
+        assert w.poll_once() == 1
+        claim = os.path.join(spool, "s1.json.claim.rA")
+        assert os.path.exists(claim)  # the lease: held while in flight
+        # the next poll heartbeats the lease (mtime refresh)
+        old = time.time() - 100
+        os.utime(claim, (old, old))
+        w.poll_once()
+        assert time.time() - os.stat(claim).st_mtime < 50
+        _drain_inline(d)
+        assert d.tracker.get("s1")["state"] == "done"
+        w.poll_once()  # terminal: the lease is released
+        assert not os.path.exists(claim)
+        assert w._inflight == {}
+    finally:
+        d.shutdown()
+
+
+def test_lease_stall_fault_skips_heartbeat(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(tmp_path)
+    spool = str(tmp_path / "spool")
+    try:
+        w = SpoolWatcher(
+            d, spool, clock=FakeClock(), replica_id="rA",
+            lease_timeout_s=5.0,
+            registry=ReplicaRegistry(d.cfg.output_path, "rA"),
+        )
+        _spool_file(spool, "s1.json", video=serve_videos[0], rid="s1")
+        w.poll_once()
+        claim = os.path.join(spool, "s1.json.claim.rA")
+        old = time.time() - 100
+        os.utime(claim, (old, old))
+        faults.install_injector(["lease_stall:error:1"])
+        w.poll_once()
+        # wedged replica: the lease mtime was NOT refreshed, so peers
+        # will see it age out and steal the work
+        assert time.time() - os.stat(claim).st_mtime > 50
+    finally:
+        d.shutdown()
+
+
+def test_stale_foreign_claim_stolen_with_warm_affinity(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(tmp_path)
+    spool = str(tmp_path / "spool")
+    try:
+        # warm resnet18 locally: steals of warm models use the base
+        # threshold, cold ones wait COLD_STEAL_FACTOR x longer
+        d.submit({"feature_type": "resnet18", "video_path": serve_videos[0],
+                  "id": "w1"}, source="local")
+        _drain_inline(d)
+        w = SpoolWatcher(
+            d, spool, clock=FakeClock(), replica_id="rA",
+            lease_timeout_s=5.0,
+            registry=ReplicaRegistry(d.cfg.output_path, "rA"),
+        )
+        warm_claim = _spool_file(
+            spool, "s2.json.claim.rB", ft="resnet18",
+            video=serve_videos[1], rid="s2",
+        )
+        cold_claim = _spool_file(
+            spool, "s3.json.claim.rB", ft="resnet34",
+            video=serve_videos[2], rid="s3",
+        )
+        # rB has no registry heartbeat at all: dead. Both claims aged 6s:
+        # past the warm threshold (5s), inside the cold one (7.5s)
+        old = time.time() - 6
+        os.utime(warm_claim, (old, old))
+        os.utime(cold_claim, (old, old))
+        assert w.poll_once() == 1  # the warm steal re-admitted s2
+        assert not os.path.exists(warm_claim)
+        assert os.path.exists(cold_claim)  # cold: warm peers get first crack
+        counters = d.telemetry.metrics.snapshot()["counters"]
+        assert counters["lease_expired"] == 1
+        assert counters["lease_steals.resnet18"] == 1
+        assert [e for e in _events(d) if e["event"] == "lease_stolen"]
+        # past the cold threshold the cold claim is stolen too
+        old = time.time() - 8
+        os.utime(cold_claim, (old, old))
+        assert w.poll_once() == 1
+        assert not os.path.exists(cold_claim)
+        _drain_inline(d)
+        assert d.tracker.get("s2")["state"] == "done"
+        assert d.tracker.get("s3")["state"] == "done"
+    finally:
+        d.shutdown()
+
+
+def test_live_owners_claim_is_never_stolen(tmp_path, serve_videos):
+    d, _ = _fleet_daemon(tmp_path)
+    spool = str(tmp_path / "spool")
+    try:
+        ReplicaRegistry(d.cfg.output_path, "rB").beat()  # rB is alive
+        w = SpoolWatcher(
+            d, spool, clock=FakeClock(), replica_id="rA",
+            lease_timeout_s=5.0,
+            registry=ReplicaRegistry(d.cfg.output_path, "rA"),
+        )
+        claim = _spool_file(
+            spool, "s1.json.claim.rB", video=serve_videos[0], rid="s1",
+        )
+        old = time.time() - 100  # mtime stale, but the OWNER is live
+        os.utime(claim, (old, old))
+        assert w.poll_once() == 0
+        assert os.path.exists(claim)
+    finally:
+        d.shutdown()
+
+
+# --- fleet reconcile: foreign replicas ---------------------------------------
+
+
+def test_reconcile_skips_live_peer_reclaims_dead(tmp_path):
+    out = str(tmp_path / "out")
+    spool = str(tmp_path / "spool")
+    tb = RequestTracker(out, replica_id="rB")
+    tb.admit(ExtractionRequest(feature_type="toy", video_path="/v.mp4",
+                               id="q1", source="spool"))
+    ta = RequestTracker(out, replica_id="rA")
+    # rB is live: its in-flight request is not a casualty
+    res = ta.reconcile(spool, live_replicas={"rB"}, require_replica=True)
+    assert res == {"requeued": 0, "interrupted": 0}
+    assert not os.path.exists(os.path.join(spool, "q1.json"))
+    # rB is dead: the request is re-queued into the spool
+    res = ta.reconcile(spool, live_replicas=set(), require_replica=True)
+    assert res == {"requeued": 1, "interrupted": 0}
+    assert os.path.exists(os.path.join(spool, "q1.json"))
+
+
+def test_reconcile_require_replica_skips_unattributed(tmp_path):
+    out = str(tmp_path / "out")
+    t0 = RequestTracker(out)  # legacy: no replica attribution
+    t0.admit(ExtractionRequest(feature_type="toy", video_path="/v.mp4",
+                               id="q1", source="local"))
+    ta = RequestTracker(out, replica_id="rA")
+    # the runtime fleet sweep must NOT disposition unattributed records —
+    # mid-flight they are indistinguishable from a live legacy request
+    res = ta.reconcile(live_replicas={"rA"}, require_replica=True)
+    assert res == {"requeued": 0, "interrupted": 0}
+    # the startup pass (no require_replica) may: it runs before sources
+    res = ta.reconcile(live_replicas={"rA"})
+    assert res == {"requeued": 0, "interrupted": 1}
+    assert ta.get("q1")["state"] == "failed"
+
+
+# --- cross-host skip-probe dedup (ISSUE 18 satellite) ------------------------
+
+
+def test_claim_skip_record_single_winner(tmp_path):
+    root = str(tmp_path / "out")
+    assert faults.claim_skip_record(root, "/v/a.mp4") is True
+    assert faults.claim_skip_record(root, "/v/a.mp4") is False  # claimed
+    assert faults.claim_skip_record(root, "/v/b.mp4") is True  # independent
+
+
+def test_claim_skip_record_two_processes_one_winner(tmp_path):
+    root = str(tmp_path / "out")
+    script = textwrap.dedent(
+        """
+        import sys
+        from video_features_tpu.runtime import faults
+        print(faults.claim_skip_record(sys.argv[1], "/shared/v.mp4"))
+        """
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    procs = [
+        subprocess.Popen([sys.executable, "-c", script, root],
+                         stdout=subprocess.PIPE, env=env)
+        for _ in range(2)
+    ]
+    outs = sorted(p.communicate(timeout=60)[0].decode().strip() for p in procs)
+    assert outs == ["False", "True"]  # exactly one winner across processes
+
+
+def test_resume_skip_recorded_once_across_repeat_resumes(toy_videos, tmp_path):
+    cfg = _cfg(toy_videos[:2], tmp_path)
+    ToyExtractor(cfg)()
+    for _ in range(2):  # two replicas/runs resuming one shared output root
+        ToyExtractor(_cfg(toy_videos[:2], tmp_path, resume=True))()
+    skips = [
+        r for r in faults.iter_manifest_records(cfg.output_path)
+        if r.get("status") == "skipped"
+    ]
+    # both done videos probed on BOTH resume passes, recorded ONCE each
+    assert sorted(r["video"] for r in skips) == sorted(toy_videos[:2])
+
+
+# --- chaos drill: SIGKILLed replica, surviving fleet recovers ----------------
+
+
+_CHAOS_VICTIM = textwrap.dedent(
+    """
+    import os, sys, time
+    from video_features_tpu.runtime import faults
+    from video_features_tpu.serve.lifecycle import (
+        ReplicaRegistry, RequestTracker, parse_request,
+    )
+    from video_features_tpu.serve.sources import SpoolWatcher
+
+    out, spool, rid = sys.argv[1:4]
+
+    class FakePool:
+        def feature_types(self):
+            return {"toy"}
+
+    class VictimDaemon:
+        # admits requests but never finishes them: everything this
+        # replica claims is in flight when the kill stage fires
+        def __init__(self):
+            self.tracker = RequestTracker(out, replica_id=rid)
+            self.pool = FakePool()
+            self.telemetry = None
+
+        def submit(self, payload, source):
+            return self.tracker.admit(parse_request(payload, source))
+
+    d = VictimDaemon()
+    reg = ReplicaRegistry(out, rid)
+    w = SpoolWatcher(d, spool, replica_id=rid, lease_timeout_s=1.0,
+                     registry=reg)
+    # pinned cadence: the SECOND poll SIGKILLs this process mid-drill —
+    # after the first poll claimed the whole burst (no cleanup, no flush)
+    faults.install_injector(["replica_kill:kill:2"])
+    w.poll_once()
+    while True:
+        w.poll_once()
+        time.sleep(0.05)
+    """
+)
+
+
+@pytest.mark.chaos
+def test_chaos_replica_kill_survivors_steal_and_finish(tmp_path):
+    """The ISSUE 18 acceptance drill: a replica SIGKILLs itself (via the
+    ``replica_kill`` fault stage) holding leases on a whole burst; two
+    survivors reclaim the stale leases and finish every request — all
+    terminal, zero duplicated feature writes, bit-identical payloads."""
+    out = str(tmp_path / "out")
+    spool = str(tmp_path / "spool")
+    feat = str(tmp_path / "features")
+    os.makedirs(feat, exist_ok=True)
+    n = 6
+    for i in range(n):
+        _spool_file(spool, f"job{i}.json", ft="toy",
+                    video=f"/media/clip{i}.mp4", rid=f"job{i}")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHAOS_VICTIM, out, spool, "victim"],
+        env=env,
+    )
+    proc.wait(timeout=120)
+    assert proc.returncode == -signal.SIGKILL  # the fault stage fired
+    claims = glob.glob(os.path.join(spool, "*.claim.victim"))
+    assert len(claims) == n  # died holding every lease
+
+    # the shared clock is file mtime: age the victim's heartbeat and
+    # leases past the 1s lease timeout without sleeping
+    old = time.time() - 30
+    for path in claims + [os.path.join(
+        requests_root(out), "_replicas", "victim.json"
+    )]:
+        os.utime(path, (old, old))
+
+    class FakePool:
+        def feature_types(self):
+            return {"toy"}
+
+    writes = []
+
+    class SurvivorDaemon:
+        def __init__(self, rid):
+            self.rid = rid
+            self.tracker = RequestTracker(out, replica_id=rid)
+            self.pool = FakePool()
+            self.telemetry = None
+
+        def submit(self, payload, source):
+            from video_features_tpu.serve.lifecycle import parse_request
+
+            req = parse_request(payload, source)
+            rec = self.tracker.admit(req)
+            # deterministic payload + atomic publish: a duplicate write
+            # would be bit-identical, but there must not BE one
+            data = hashlib.sha256(req.video_path.encode()).hexdigest().encode()
+            dest = os.path.join(feat, f"{req.id}.bin")
+            tmp = f"{dest}.{self.rid}.tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, dest)
+            writes.append((self.rid, req.id))
+            self.tracker.finish(req, "done", features=[dest])
+            return rec
+
+    survivors = []
+    for rid in ("sA", "sB"):
+        d = SurvivorDaemon(rid)
+        reg = ReplicaRegistry(out, rid)
+        reg.beat()
+        survivors.append((d, SpoolWatcher(
+            d, spool, replica_id=rid, lease_timeout_s=1.0, registry=reg,
+        )))
+    for _ in range(3):  # reclaim pass + claim/admit pass + lease release
+        for _, w in survivors:
+            w.poll_once()
+
+    # every request terminal 'done' (result files are fleet-shared)
+    for i in range(n):
+        rec = survivors[0][0].tracker.get(f"job{i}")
+        assert rec is not None and rec["state"] == "done", rec
+    # zero duplicated feature writes, each with the expected bytes
+    assert sorted(rid for _, rid in writes) == [f"job{i}" for i in range(n)]
+    files = sorted(os.listdir(feat))
+    assert files == [f"job{i}.bin" for i in range(n)]
+    for i in range(n):
+        with open(os.path.join(feat, f"job{i}.bin"), "rb") as fh:
+            expect = hashlib.sha256(
+                f"/media/clip{i}.mp4".encode()
+            ).hexdigest().encode()
+            assert fh.read() == expect
+    # the spool is fully drained: no jsons, no leases left behind
+    assert [f for f in os.listdir(spool) if not f.startswith(".")] == []
+    # the steal trail is durable
+    stolen = [
+        r for r in faults.iter_manifest_records(requests_root(out))
+        if r.get("event") == "lease_stolen"
+    ]
+    assert len(stolen) == n
+    assert {r["from_replica"] for r in stolen} == {"victim"}
+    # and the fleet sweep has nothing left to disposition
+    res = survivors[0][0].tracker.reconcile(
+        spool, live_replicas={"sA", "sB"}, require_replica=True
+    )
+    assert res == {"requeued": 0, "interrupted": 0}

@@ -712,13 +712,16 @@ def test_pool_failed_build_clears_latch_and_retries():
 
     pool = ExtractorPool.__new__(ExtractorPool)
     import threading
+    import time
     pool._cfg = None
     pool._max_group_size = 1
     pool._build = build
+    pool._clock = time.monotonic
     pool._lock = threading.Lock()
     pool._extractors = {}
     pool._building = {}
     pool.build_count = {}
+    pool.built_at = {}
     pool._serving_config = lambda ft: SimpleNamespace(feature_type=ft)
     with pytest.raises(RuntimeError):
         pool.get("resnet18")

@@ -811,9 +811,33 @@ class ServeConfig:
     # fail warmup fast when the cost ledger's projected resident HBM for
     # the resident models exceeds this many bytes (0 = unlimited)
     hbm_budget_bytes: int = 0
+    # HBM-aware preemption (serve/preemptor.py, ISSUE 18): "on" lets an
+    # overcommitting burst evict the lowest-value resident extractor
+    # instead of being rejected; hysteresis = one preemption per
+    # cooldown + a min-residency guard on every victim
+    preempt: str = "off"
+    preempt_cooldown_s: float = 30.0
+    preempt_min_residency_s: float = 60.0
+    # fleet identity + spool work-stealing (serve/sources.py): replicas
+    # sharing one spool/output claim via per-replica lease files; a
+    # lease whose heartbeat is older than lease_timeout_s is stolen by
+    # a survivor (0 disables stealing — single-replica behavior)
+    replica_id: Optional[str] = None
+    lease_timeout_s: float = 0.0
+    # hit-rate-aware shedding: past this fraction of max_queue, likely-
+    # cache-miss requests are shed first (0 disables; only acts when
+    # the observed cache hit rate says hits are common enough to save
+    # room for)
+    shed_watermark: float = 0.0
 
     def warmup_pairs(self) -> List[tuple]:
         return [parse_warmup_spec(s) for s in self.warmup]
+
+    def resolved_replica_id(self) -> str:
+        """The configured ``--replica_id`` or a pid-derived default —
+        stable for the life of the process, unique enough on one host;
+        multi-host fleets should set it explicitly."""
+        return self.replica_id or f"r{os.getpid()}"
 
 
 def parse_warmup_spec(spec: str) -> tuple:
@@ -914,6 +938,32 @@ def build_serve_arg_parser() -> argparse.ArgumentParser:
                         "resident models' HBM footprint past this many "
                         "bytes (0 = unlimited; see docs/observability.md "
                         "\"Device cost ledger\")")
+    g.add_argument("--preempt", choices=("on", "off"), default="off",
+                   help="HBM-aware preemption: a burst whose ledger-"
+                        "projected footprint cannot fit evicts the "
+                        "lowest-value resident extractor (breaker "
+                        "teardown + re-warm) instead of being rejected "
+                        "(see docs/serving.md \"Fleet operation\")")
+    g.add_argument("--preempt_cooldown_s", type=float, default=30.0,
+                   help="minimum seconds between preemptions (hysteresis "
+                        "so two bursts cannot thrash-evict each other)")
+    g.add_argument("--preempt_min_residency_s", type=float, default=60.0,
+                   help="a resident extractor younger than this is never "
+                        "chosen as a preemption victim")
+    g.add_argument("--replica_id", type=str, default=None,
+                   help="this replica's stable identity in a multi-"
+                        "replica fleet sharing one spool + output store "
+                        "(default: pid-derived; set explicitly across "
+                        "hosts)")
+    g.add_argument("--lease_timeout_s", type=float, default=0.0,
+                   help="spool claims become per-replica leases; a lease "
+                        "whose heartbeat is older than this is stolen by "
+                        "a surviving replica (0 disables work-stealing)")
+    g.add_argument("--shed_watermark", type=float, default=0.0,
+                   help="queue-saturation fraction of --max_queue past "
+                        "which likely-cache-miss requests are shed first "
+                        "(cache hits are ~ms and are never shed; 0 "
+                        "disables)")
     return p
 
 
@@ -952,6 +1002,12 @@ def parse_serve_args(argv: Optional[Sequence[str]] = None) -> ServeConfig:
         warmup=list(args.warmup or []),
         warmup_only=warmup_only,
         hbm_budget_bytes=args.hbm_budget_bytes,
+        preempt=args.preempt,
+        preempt_cooldown_s=args.preempt_cooldown_s,
+        preempt_min_residency_s=args.preempt_min_residency_s,
+        replica_id=args.replica_id,
+        lease_timeout_s=args.lease_timeout_s,
+        shed_watermark=args.shed_watermark,
     )
     return sanity_check_serve(scfg)
 
@@ -996,6 +1052,27 @@ def sanity_check_serve(scfg: ServeConfig) -> ServeConfig:
         raise ValueError(f"retention_sweep_s must be >= 0, got {scfg.retention_sweep_s}")
     if scfg.hbm_budget_bytes < 0:
         raise ValueError(f"hbm_budget_bytes must be >= 0, got {scfg.hbm_budget_bytes}")
+    if scfg.preempt not in ("on", "off"):
+        raise ValueError(f"preempt must be 'on' or 'off', got {scfg.preempt!r}")
+    if scfg.preempt_cooldown_s < 0:
+        raise ValueError(
+            f"preempt_cooldown_s must be >= 0, got {scfg.preempt_cooldown_s}")
+    if scfg.preempt_min_residency_s < 0:
+        raise ValueError(
+            "preempt_min_residency_s must be >= 0, got "
+            f"{scfg.preempt_min_residency_s}")
+    if scfg.replica_id is not None and not re.fullmatch(
+            r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}", scfg.replica_id):
+        # replica ids become claim-file suffixes and heartbeat filenames
+        raise ValueError(
+            "replica_id must be 1-64 chars of [A-Za-z0-9._-] starting "
+            f"alphanumeric, got {scfg.replica_id!r}")
+    if scfg.lease_timeout_s < 0:
+        raise ValueError(
+            f"lease_timeout_s must be >= 0, got {scfg.lease_timeout_s}")
+    if not 0 <= scfg.shed_watermark <= 1:
+        raise ValueError(
+            f"shed_watermark must be in [0, 1], got {scfg.shed_watermark}")
     scfg.warmup_pairs()  # raises naming any bad spec
     if scfg.warmup_only and not scfg.warmup:
         raise ValueError("serve warmup needs at least one --warmup FEATURE_TYPE:WxH")

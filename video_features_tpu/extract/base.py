@@ -412,7 +412,15 @@ class BaseExtractor:
         return None
 
     def _skip(self, entry, reason: str) -> None:
-        self.manifest.record(self._video_key(entry), "skipped", message=reason)
+        # Cross-host resume dedup (ISSUE 18): replicas resuming one
+        # shared output root each probe the same finished videos; only
+        # the winner of an O_EXCL claim file records the "skipped" line,
+        # so fleet-level skip counts stay per-video, not per-replica.
+        key = self._video_key(entry)
+        if self.manifest is NULL_MANIFEST or faults.claim_skip_record(
+            self.config.output_path, key
+        ):
+            self.manifest.record(key, "skipped", message=reason)
         self.progress.update()
 
     # --- content-addressed feature cache (extract/cache.py) ---------------

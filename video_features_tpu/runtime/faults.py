@@ -41,6 +41,7 @@ import glob
 import hashlib
 import json
 import os
+import signal
 import threading
 import time
 import uuid
@@ -54,9 +55,18 @@ SUMMARY_BASENAME = "summary.json"
 # stages (ISSUE 8): request admission, the group body around the
 # extractor call, the resident extractor itself (breaker/teardown
 # coverage), and the durable result write.
+# replica_kill/hbm_squeeze/lease_stall are the fleet chaos stages
+# (ISSUE 18): replica_kill fires in the spool watcher's poll pass (kind
+# 'kill' SIGKILLs the whole replica process — the work-stealing drill),
+# hbm_squeeze fires in the daemon's headroom read (any raising kind
+# collapses the observed HBM headroom to zero, forcing the preemption
+# path without a real device), and lease_stall fires in the lease
+# heartbeat (a raising kind skips that pass's mtime refresh, so the
+# replica's leases go stale while the process is still alive).
 STAGES = (
     "decode", "prepare", "dispatch", "sink",
     "admission", "serve_dispatch", "extractor", "tracker_write",
+    "replica_kill", "hbm_squeeze", "lease_stall",
 )
 KINDS = ("error", "corrupt", "hang", "oom", "compile", "kill")
 # how long an injected 'hang' sleeps; tests pair it with a shorter
@@ -292,6 +302,11 @@ class FaultInjector:
         if spec.kind == "hang":
             time.sleep(HANG_SECONDS)  # the real deadline check must fire
             return
+        if spec.stage == "replica_kill" and spec.kind == "kill":
+            # the chaos drill is a REAL SIGKILL: no atexit, no finally,
+            # no flush — exactly the death the lease-expiry reclamation
+            # and foreign-replica reconcile exist to survive
+            os.kill(os.getpid(), signal.SIGKILL)
         exc: Exception
         if spec.kind == "error":
             exc = InjectedTransientError(f"{tag}: transient I/O error")
@@ -336,6 +351,37 @@ def fire(stage: str) -> None:
 
 def manifest_dir(output_root: str) -> str:
     return os.path.join(output_root, MANIFEST_DIRNAME)
+
+
+_SKIP_CLAIM_DIRNAME = "_skip_claims"
+
+
+def claim_skip_record(output_root: str, video_key: str) -> bool:
+    """Cross-host dedup for ``--resume`` ``skipped`` manifest records on
+    shared storage: two replicas resuming the same output root both
+    probe the same already-done video, and without coordination both
+    append a ``skipped`` record — double-counting the video in the
+    merged summary. The claim is a file created O_CREAT|O_EXCL next to
+    the manifest (atomic on POSIX and NFS alike), keyed by the video
+    key's sha1 — exactly one process wins and records; losers still
+    skip the work, just silently. A claim-side I/O failure (read-only
+    fs, permissions) returns True: recording a duplicate beats dropping
+    the record."""
+    claim_dir = os.path.join(manifest_dir(output_root), _SKIP_CLAIM_DIRNAME)
+    digest = hashlib.sha1(str(video_key).encode("utf-8", "replace")).hexdigest()
+    path = os.path.join(claim_dir, f"{digest}.claim")
+    try:
+        os.makedirs(claim_dir, exist_ok=True)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    except OSError:
+        return True
+    try:
+        os.write(fd, f"{os.getpid()} {video_key}\n".encode("utf-8", "replace"))
+    finally:
+        os.close(fd)
+    return True
 
 
 class RunManifest:

@@ -51,9 +51,11 @@ from video_features_tpu.serve.lifecycle import (
     BadRequest,
     ExtractionRequest,
     InvalidMedia,
+    ReplicaRegistry,
     RequestTracker,
     parse_request,
 )
+from video_features_tpu.serve.preemptor import PreemptionPlan, Preemptor
 from video_features_tpu.serve.scheduler import build_scheduler
 from video_features_tpu.serve.supervisor import (
     CircuitBreaker,
@@ -123,16 +125,21 @@ class ExtractorPool:
         cfg: ExtractionConfig,
         max_group_size: int,
         build: Callable[..., Any] = build_extractor,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._cfg = cfg
         self._max_group_size = max(int(max_group_size), 1)
         self._build = build
+        self._clock = clock
         self._lock = threading.Lock()
         self._extractors: Dict[str, Any] = {}
         # per-feature-type build latch: the winning builder publishes and
         # sets it; losers wait OUTSIDE the pool lock (see get())
         self._building: Dict[str, threading.Event] = {}
         self.build_count: Dict[str, int] = {}
+        # when each resident was (re)built, on the daemon's clock — the
+        # preemptor's min-residency guard reads this (ISSUE 18)
+        self.built_at: Dict[str, float] = {}
 
     def _serving_config(self, feature_type: str) -> ExtractionConfig:
         """The per-feature-type extraction config: the daemon's base
@@ -190,6 +197,7 @@ class ExtractorPool:
                     self.build_count[feature_type] = (
                         self.build_count.get(feature_type, 0) + 1
                     )
+                    self.built_at[feature_type] = self._clock()
                 return ext
             finally:
                 with self._lock:
@@ -207,6 +215,7 @@ class ExtractorPool:
         warm compile cache, fresh everything else."""
         with self._lock:
             ext = self._extractors.pop(feature_type, None)
+            self.built_at.pop(feature_type, None)
         if ext is not None:
             try:
                 ext.telemetry.close()
@@ -270,14 +279,30 @@ class ServeDaemon:
             self.telemetry.metrics,
             interval_s=max(float(self.cfg.heartbeat_s or 0.0), 10.0),
         )
+        # fleet identity (ISSUE 18): every manifest line is attributed
+        # to this replica, and the registry heartbeat is how surviving
+        # peers on a shared output store learn this process is alive
+        self.replica_id = scfg.resolved_replica_id()
+        self.registry = ReplicaRegistry(self.cfg.output_path, self.replica_id)
+        self.registry.beat()
         self.tracker = RequestTracker(
             self.cfg.output_path, telemetry=self.telemetry,
-            slo=self.slo, clock=clock,
+            slo=self.slo, clock=clock, replica_id=self.replica_id,
         )
         # crash recovery BEFORE any source can admit: requests a dead
         # process left queued/dispatched reach a durable state (spool
-        # files re-queued, HTTP requests failed 'interrupted')
-        self.recovered = self.tracker.reconcile(scfg.spool_dir)
+        # files re-queued, HTTP requests failed 'interrupted'). In a
+        # fleet (lease_timeout_s > 0) LIVE peers' in-flight requests are
+        # not casualties — skip them; our own prior incarnation is never
+        # "live" to us at startup, so a same-id restart still recovers.
+        live_peers = None
+        if scfg.lease_timeout_s > 0:
+            live_peers = (
+                self.registry.live(scfg.lease_timeout_s) - {self.replica_id}
+            )
+        self.recovered = self.tracker.reconcile(
+            scfg.spool_dir, live_replicas=live_peers
+        )
         if any(self.recovered.values()):
             print(f"serve: recovered prior run: {self.recovered['requeued']} "
                   f"requeued, {self.recovered['interrupted']} interrupted")
@@ -288,7 +313,9 @@ class ServeDaemon:
         from video_features_tpu.io.probe import ResourceCaps
 
         self._caps = ResourceCaps.from_config(self.cfg)
-        self.pool = ExtractorPool(self.cfg, scfg.max_group_size, build=build)
+        self.pool = ExtractorPool(
+            self.cfg, scfg.max_group_size, build=build, clock=clock
+        )
         # content-addressed feature cache (extract/cache.py): a repeat
         # request for an already-extracted (content, config) pair goes
         # terminal 'done' at admission — no queue, no decode, no chip.
@@ -331,6 +358,27 @@ class ServeDaemon:
         )
         self.watchdog = Watchdog(scfg.group_timeout_s)
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # HBM-aware preemption (serve/preemptor.py): only constructed
+        # when --preempt on; with it off, an overcommitting burst keeps
+        # today's behavior (no admission HBM gate)
+        self.preemptor: Optional[Preemptor] = None
+        self._preempt_plans: Dict[str, PreemptionPlan] = {}
+        if scfg.preempt == "on":
+            self.preemptor = Preemptor(
+                ledger=self.ledger,
+                cost_model=self.cost_model,
+                pool=self.pool,
+                breaker_for=self._breaker,
+                headroom_fn=self._headroom_bytes,
+                queued_fn=self.batcher.queued_by_feature_type,
+                hbm_budget_bytes=scfg.hbm_budget_bytes,
+                cooldown_s=scfg.preempt_cooldown_s,
+                min_residency_s=scfg.preempt_min_residency_s,
+                clock=clock,
+                metrics=(self.telemetry.metrics
+                         if self.telemetry.enabled else None),
+                manifest=self.tracker.manifest,
+            )
         self._cancel_pending: set = set()
         self._http_server: Any = None
         self._http_thread: Any = None
@@ -391,6 +439,7 @@ class ServeDaemon:
                 # admission, skipping queue/scheduler/chip entirely
                 self.tracker.admit(req)
                 return self.tracker.finish(req, "done", features=files)
+            self._maybe_shed(req)
             faults.fire("admission")
             breaker = self._breaker(req.feature_type)
             if not breaker.allow_request():
@@ -401,10 +450,13 @@ class ServeDaemon:
                     # the open
                     self.tracker.reject(req, str(exc))
                 raise exc
+            self._hbm_gate(req)
             rec = self.tracker.admit(req)
             try:
                 self.batcher.admit(req)
             except QueueFull:
+                if self.telemetry.enabled:
+                    self.telemetry.metrics.inc("requests_shed.queue_full")
                 if req.source == "spool":
                     # the spool file survives and re-submits under the
                     # same id next poll: back the admit out, no terminal
@@ -436,6 +488,93 @@ class ServeDaemon:
         reason = f"invalid media: {report.reason}"
         rec = self.tracker.reject(req, reason)
         raise InvalidMedia(reason, record=rec)
+
+    # -- hit-rate-aware shedding (ISSUE 18 satellite) ---------------------
+
+    def _maybe_shed(self, req: ExtractionRequest) -> None:
+        """Saturation triage: past ``--shed_watermark`` × max_queue,
+        shed requests the feature cache cannot answer. Runs AFTER
+        :meth:`_cache_lookup`, so a cache hit has already gone terminal
+        ``done`` and can never be shed; what reaches here is a known
+        miss — the expensive kind — and shedding it keeps admission room
+        for the ~ms hits. Only acts when the observed hit rate says hits
+        are actually common (>= 50% over >= 20 lookups); a cold or
+        miss-heavy cache sheds nothing and the plain queue bound rules."""
+        wm = float(getattr(self.scfg, "shed_watermark", 0.0) or 0.0)
+        if wm <= 0 or self.cache is None or not self.telemetry.enabled:
+            return
+        if self.batcher.depth() < wm * self.scfg.max_queue:
+            return
+        counters = self.telemetry.metrics.snapshot().get("counters", {})
+        hits = sum(
+            v for k, v in counters.items() if k.startswith("cache_hit.")
+        )
+        misses = sum(
+            v for k, v in counters.items() if k.startswith("cache_miss.")
+        )
+        total = hits + misses
+        if total < 20 or hits / total < 0.5:
+            return
+        self.telemetry.metrics.inc("requests_shed.likely_cache_miss")
+        msg = (
+            f"queue saturated ({self.batcher.depth()}/{self.scfg.max_queue})"
+            " and this request missed the feature cache; shed to preserve"
+            " admission room for cache hits"
+        )
+        if req.source != "spool":
+            # terminal record for HTTP/local callers; a spool file is its
+            # own durable record and simply retries after backoff
+            self.tracker.reject(req, msg)
+        raise QueueFull(msg)
+
+    # -- HBM-aware preemption (ISSUE 18 tentpole) -------------------------
+
+    def _headroom_bytes(self) -> Optional[int]:
+        """The live ``device_mem_headroom_bytes`` gauge (min across
+        devices, set by the DeviceMemorySampler), or None on backends
+        without memory_stats — the preemptor then falls back to the
+        static ``--hbm_budget_bytes`` arithmetic."""
+        gauges = self.telemetry.metrics.snapshot().get("gauges", {})
+        h = gauges.get("device_mem_headroom_bytes")
+        return int(h) if h is not None else None
+
+    def _hbm_gate(self, req: ExtractionRequest) -> None:
+        """Admission HBM arbitration (only with ``--preempt on``): a
+        request for a non-resident model whose ledger-projected footprint
+        cannot fit beside the resident set first tries to preempt the
+        lowest-value residents; only if even that cannot make room is it
+        refused (503 with the cooldown as Retry-After; spool files defer
+        and retry, exactly like an open breaker)."""
+        if self.preemptor is None:
+            return
+        verdict, needed, available = self.preemptor.check(req.feature_type)
+        if verdict != "overcommit":
+            return
+        plan = self.preemptor.ensure_room(req.feature_type)
+        if plan is not None:
+            # remember the sacrifice until the beneficiary's build
+            # succeeds — a failed build rolls the victims back
+            with self._lock:
+                self._preempt_plans[req.feature_type] = plan
+            return
+        if self.preemptor.check(req.feature_type)[0] != "overcommit":
+            return  # a concurrent admission already made room
+        exc = ModelUnavailable(
+            req.feature_type, self.scfg.preempt_cooldown_s,
+            reason=(
+                f"model {req.feature_type!r} cannot fit: needs {needed} "
+                f"bytes of HBM, {available} available, and no resident "
+                f"extractor is preemptible right now; retry in "
+                f"{self.scfg.preempt_cooldown_s:.1f}s"
+            ),
+        )
+        if req.source != "spool":
+            self.tracker.reject(req, str(exc))
+        raise exc
+
+    def _pop_plan(self, feature_type: str) -> Optional[PreemptionPlan]:
+        with self._lock:
+            return self._preempt_plans.pop(feature_type, None)
 
     # -- multi-model fan-out ----------------------------------------------
 
@@ -571,6 +710,9 @@ class ServeDaemon:
         deadline already passed leave as ``expired`` BEFORE the group
         touches the chip — an expired request must not burn compute."""
         feature_type = key[0]
+        breaker: Optional[CircuitBreaker] = None
+        probing = False
+        resolved = False  # has the probe slot reported a verdict?
         try:
             live = self._boundary_filter(requests)
             if not live:
@@ -592,14 +734,26 @@ class ServeDaemon:
             except Exception as exc:  # noqa: BLE001 - build/re-warm failed: fail the group
                 msg = f"extractor build failed: {type(exc).__name__}: {exc}"
                 traceback.print_exc()
+                # breaker verdict FIRST: the tracker writes below can
+                # themselves raise (fault injection, full disk), and a
+                # half-open probe slot claimed but never resolved would
+                # wedge this model's admissions forever (ISSUE 18
+                # satellite bugfix)
+                if breaker.record_failure():
+                    self.pool.evict(feature_type)
+                resolved = True
+                plan = self._pop_plan(feature_type)
+                if plan is not None and self.preemptor is not None:
+                    # this build was a preemption's beneficiary: hand the
+                    # victims their slots back rather than serving neither
+                    self.preemptor.rollback(plan)
                 for r in live:
                     self.tracker.finish(
                         r, "failed", error_class=faults.classify_error(exc),
                         error_type=type(exc).__name__, message=msg,
                     )
-                if breaker.record_failure():
-                    self.pool.evict(feature_type)
                 return
+            self._pop_plan(feature_type)  # built: the preemption held up
             for r in live:
                 self.tracker.dispatched(r, group_size=len(live))
             # module-level telemetry hooks (decode frame counters, bucket
@@ -647,8 +801,17 @@ class ServeDaemon:
                     breaker.record_ignored()
                 elif breaker.record_failure() or isinstance(exc, GroupTimeout):
                     self.pool.evict(feature_type)
+                resolved = True
                 return
             breaker.record_success()
+            resolved = True
+            if probing:
+                # durable recovery trail: the re-warmed model just proved
+                # itself end to end (pairs with the 'preempted' event
+                # when the open was a preemption trip)
+                self.tracker.manifest.event(
+                    "rewarmed", feature_type=feature_type
+                )
             # feed the online service-time estimator and the per-
             # (feature_type, bucket) /metrics histogram from the group
             # that just completed: the cost model only ever learns from
@@ -678,6 +841,13 @@ class ServeDaemon:
                         message=got.get("message"),
                     )
         finally:
+            if probing and not resolved and breaker is not None:
+                # safety net for any exception that escaped between
+                # try_probe() and the breaker verdict: release the
+                # half-open probe slot WITHOUT a verdict so the next
+                # admitted group re-probes — a leaked slot would 503
+                # this model until restart
+                breaker.record_ignored()
             with self._lock:
                 self._cancel_pending.difference_update(r.id for r in requests)
 
@@ -875,7 +1045,10 @@ class ServeDaemon:
             from video_features_tpu.serve.sources import SpoolWatcher
 
             self._spool = SpoolWatcher(
-                self, self.scfg.spool_dir, poll_s=self.scfg.spool_poll_s
+                self, self.scfg.spool_dir, poll_s=self.scfg.spool_poll_s,
+                replica_id=self.replica_id,
+                lease_timeout_s=self.scfg.lease_timeout_s,
+                registry=self.registry,
             )
             self._spool.start()
         if self.scfg.port is not None:
@@ -898,8 +1071,36 @@ class ServeDaemon:
                 self.tracker.sweep(
                     self.scfg.request_ttl_s, self.scfg.max_request_records
                 )
+                self._fleet_sweep()
             except Exception:  # noqa: BLE001 - retention must not kill serving
                 traceback.print_exc()
+
+    def _fleet_sweep(self) -> None:
+        """The survivors' side of fleet recovery (ISSUE 18): refresh our
+        own heartbeat, export a ``replica_up`` gauge per known replica,
+        and disposition requests whose owning replica is dead —
+        requeue/fail via reconcile, restricted to replica-attributed
+        records (``require_replica``) so a live-but-unattributed request
+        is never declared a casualty mid-flight."""
+        if self.scfg.lease_timeout_s <= 0:
+            return
+        self.registry.beat()
+        timeout = self.scfg.lease_timeout_s
+        ages = self.registry.ages()
+        if self.telemetry.enabled:
+            for rid, age in ages.items():
+                self.telemetry.metrics.set_gauge(
+                    f"replica_up.{rid}", 1 if age <= timeout else 0
+                )
+        live = {rid for rid, age in ages.items() if age <= timeout}
+        live.add(self.replica_id)  # we are provably alive
+        recovered = self.tracker.reconcile(
+            self.scfg.spool_dir, live_replicas=live, require_replica=True
+        )
+        if any(recovered.values()):
+            print(f"serve: fleet sweep reclaimed a dead replica's work: "
+                  f"{recovered['requeued']} requeued, "
+                  f"{recovered['interrupted']} interrupted")
 
     def status(self) -> Dict[str, Any]:
         """The /healthz body: queue depth, per-state request counts,
@@ -908,7 +1109,7 @@ class ServeDaemon:
         with self._lock:
             breakers = {ft: b.snapshot() for ft, b in sorted(self._breakers.items())}
         degraded = any(b["state"] != "closed" for b in breakers.values())
-        return {
+        out = {
             "status": "degraded" if degraded else "ok",
             "queue_depth": self.batcher.depth(),
             "max_queue": self.scfg.max_queue,
@@ -918,7 +1119,11 @@ class ServeDaemon:
             "scheduler": self.scfg.scheduler,
             "breakers": breakers,
             "watchdog_timeouts": self.watchdog.timeouts(),
+            "replica": self.replica_id,
         }
+        if self.preemptor is not None:
+            out["preemptor"] = self.preemptor.snapshot()
+        return out
 
     def stats(self) -> Dict[str, Any]:
         """The /v1/stats body: /healthz plus the SLO window digest, the
@@ -1091,6 +1296,9 @@ class ServeDaemon:
                     message="daemon shutdown before dispatch; resubmit to retry",
                 )
         self.pool.close()
+        # clean exit: drop the heartbeat so surviving replicas reclaim
+        # anything we still lease immediately, not after a lease timeout
+        self.registry.retire()
         if self._frame_cache is not None:
             # uninstall the shared-decode hook: a later daemon (or batch
             # run) in this process must not replay this daemon's frames

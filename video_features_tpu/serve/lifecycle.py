@@ -71,6 +71,14 @@ class BadRequest(ValueError):
     the same way."""
 
 
+class DuplicateRequest(BadRequest):
+    """A request id that is already tracked live in THIS process. Still
+    a 400 for HTTP callers (it subclasses :class:`BadRequest`), but the
+    spool source treats it as benign — after a lease steal or a
+    reconcile requeue the same request can briefly exist as two spool
+    files, and the loser must be dropped, not quarantined."""
+
+
 class InvalidMedia(BadRequest):
     """The request was well-formed but its media failed the preflight
     probe (io/probe.py): HTTP callers get 422 ``invalid_media`` with the
@@ -162,6 +170,73 @@ def requests_root(output_root: str) -> str:
     return os.path.join(output_root, REQUESTS_DIRNAME)
 
 
+REPLICAS_DIRNAME = "_replicas"
+
+
+class ReplicaRegistry:
+    """Fleet membership over the shared output store (ISSUE 18): each
+    serve replica periodically touches ``_requests/_replicas/<id>.json``;
+    liveness is heartbeat-file mtime, on the WALL clock — the one clock
+    N processes on a shared filesystem actually share. Survivors use
+    :meth:`live` to decide which dead replicas' in-flight requests to
+    reclaim (``RequestTracker.reconcile``) and which spool leases are
+    stale (``SpoolWatcher``). Tests fake staleness with ``os.utime``."""
+
+    def __init__(self, output_root: str, replica_id: str) -> None:
+        self.dir = os.path.join(requests_root(output_root), REPLICAS_DIRNAME)
+        self.replica_id = str(replica_id)
+        self.path = os.path.join(self.dir, f"{self.replica_id}.json")
+
+    def beat(self) -> None:
+        """Refresh this replica's heartbeat (tmp + rename: a reader never
+        sees a torn file, and the rename refreshes mtime atomically)."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"replica": self.replica_id, "pid": os.getpid(),
+                           "ts": round(time.time(), 3)}, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a missed beat is survivable; a crashed beat is not
+
+    def retire(self) -> None:
+        """Clean shutdown: drop the heartbeat so survivors reclaim this
+        replica's leases immediately instead of after a timeout."""
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def ages(self, now: Optional[float] = None) -> Dict[str, float]:
+        """``{replica_id: heartbeat age in seconds}`` for every replica
+        with a heartbeat file (including this one)."""
+        now = time.time() if now is None else now
+        out: Dict[str, float] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                mtime = os.stat(os.path.join(self.dir, name)).st_mtime
+            except OSError:
+                continue
+            out[name[: -len(".json")]] = max(now - mtime, 0.0)
+        return out
+
+    def live(self, timeout_s: float, now: Optional[float] = None) -> set:
+        """Replica ids whose heartbeat is fresher than ``timeout_s``.
+        ``timeout_s <= 0`` means liveness is never inferred: everyone
+        with a heartbeat file counts as live (steal protocol disabled)."""
+        ages = self.ages(now)
+        if timeout_s <= 0:
+            return set(ages)
+        return {rid for rid, age in ages.items() if age <= timeout_s}
+
+
 class RequestTracker:
     """Thread-safe request registry + the manifest/result-file writers.
 
@@ -176,10 +251,15 @@ class RequestTracker:
         telemetry: Any = None,
         slo: Any = None,
         clock: Any = time.monotonic,
+        replica_id: Optional[str] = None,
     ) -> None:
         self.output_root = output_root
         self.results_dir = requests_root(output_root)
         self.manifest = RunManifest(self.results_dir)
+        # fleet attribution (ISSUE 18): every manifest line this tracker
+        # writes carries replica=<id>, so a survivor's reconcile can tell
+        # a DEAD replica's in-flight requests from a live peer's
+        self.replica_id = replica_id
         self.telemetry = telemetry
         # the daemon's SloTracker (runtime/telemetry.py) and its
         # scheduling clock: latency/queue-wait samples are measured on
@@ -210,7 +290,7 @@ class RequestTracker:
             rec["deadline_ms"] = float(req.deadline_ms)
         with self._lock:
             if req.id in self._records:
-                raise BadRequest(f"duplicate request id {req.id!r}")
+                raise DuplicateRequest(f"duplicate request id {req.id!r}")
             self._records[req.id] = rec
         self._count("requests_admitted")
         if self.telemetry is not None and self.telemetry.enabled:
@@ -240,7 +320,7 @@ class RequestTracker:
             extra["priority"] = int(req.priority)
         if req.deadline_ms is not None:
             extra["deadline_ms"] = float(req.deadline_ms)
-        self.manifest.record(
+        self._record(
             f"request:{req.id}", "queued",
             feature_type=req.feature_type, video_path=req.video_path,
             bucket=req.bucket, source=req.source, **extra,
@@ -261,7 +341,7 @@ class RequestTracker:
             qtoken = self._qspans.pop(req.id, None)
         if qtoken is not None:
             qtoken.finish(group_size=int(group_size))
-        self.manifest.record(
+        self._record(
             f"request:{req.id}", "dispatched", group_size=int(group_size)
         )
 
@@ -337,7 +417,7 @@ class RequestTracker:
             for k in ("error_class", "error_type", "message", "wall_s")
             if k in out
         }
-        self.manifest.record(f"request:{req.id}", status, **extra)
+        self._record(f"request:{req.id}", status, **extra)
         try:
             self._write_result(out)
         except OSError as exc:
@@ -366,7 +446,7 @@ class RequestTracker:
         if token is not None:
             token.finish(state="deferred")
         self._count("requests_deferred")
-        self.manifest.record(f"request:{req.id}", "deferred")
+        self._record(f"request:{req.id}", "deferred")
 
     def reject(self, req: ExtractionRequest, reason: str) -> Dict[str, Any]:
         """Backpressure / bad-input terminal state: the request never
@@ -410,18 +490,34 @@ class RequestTracker:
         if token is not None:
             token.finish(state="requeued")
         self._count("requests_requeued")
-        self.manifest.record(f"request:{req.id}", "requeued")
+        self._record(f"request:{req.id}", "requeued")
 
     # -- crash recovery + retention -------------------------------------
 
-    def reconcile(self, spool_dir: Optional[str] = None) -> Dict[str, int]:
-        """Startup pass over prior processes' request manifests: every
+    def reconcile(
+        self,
+        spool_dir: Optional[str] = None,
+        live_replicas: Optional[set] = None,
+        require_replica: bool = False,
+    ) -> Dict[str, int]:
+        """Pass over prior/peer processes' request manifests: every
         request a dead daemon left non-terminal (queued/dispatched)
         reaches a durable state — re-queued into the spool when it came
         from one (and a spool is configured), else marked ``failed`` /
         interrupted with a result record the status endpoint can serve.
-        Runs before any source opens, so every folded record belongs to
-        a previous process."""
+
+        Single-replica (both fleet arguments at their defaults) this is
+        the startup pass it has always been: it runs before any source
+        opens, so every folded record belongs to a previous process.
+        Fleet mode (ISSUE 18): ``live_replicas`` is the set of replica
+        ids with a fresh heartbeat — a request whose latest manifest line
+        is attributed to a LIVE peer is skipped (it is that peer's
+        in-flight work, not a casualty); ``require_replica=True`` (the
+        survivors' periodic sweep) additionally skips records with no
+        replica attribution at all, because mid-flight there is no way
+        to tell an unattributed live request from a dead one — only the
+        startup pass, which runs before any source opens, may disposition
+        those legacy records."""
         folded: Dict[str, Dict[str, Any]] = {}
         for r in faults_mod.iter_manifest_records(self.results_dir):
             key = r.get("video")
@@ -432,6 +528,11 @@ class RequestTracker:
             status = r.get("status")
             if status:
                 cur["state"] = status
+                # attribution follows the state: the replica that wrote
+                # the LATEST transition owns the request now (a requeued
+                # request re-admitted elsewhere belongs to its new home)
+                if r.get("replica") is not None:
+                    cur["replica"] = r["replica"]
             for f in ("feature_type", "video_path", "bucket", "source",
                       "priority", "deadline_ms"):
                 if r.get(f) is not None:
@@ -440,6 +541,12 @@ class RequestTracker:
         for rid, rec in sorted(folded.items()):
             state = rec.get("state")
             if state in TERMINAL_STATES or state in _SPOOL_SAFE_STATES:
+                continue
+            owner = rec.get("replica")
+            if owner is None and require_replica:
+                continue
+            if live_replicas is not None and owner is not None \
+                    and owner in live_replicas:
                 continue
             req = ExtractionRequest(
                 feature_type=str(rec.get("feature_type") or ""),
@@ -571,6 +678,11 @@ class RequestTracker:
     def _count(self, name: str) -> None:
         if self.telemetry is not None and self.telemetry.enabled:
             self.telemetry.metrics.inc(name)
+
+    def _record(self, key: str, status: str, **extra: Any) -> None:
+        if self.replica_id is not None:
+            extra.setdefault("replica", self.replica_id)
+        self.manifest.record(key, status, **extra)
 
     def _write_result(self, rec: Dict[str, Any]) -> None:
         """tmp + rename so a status reader never sees a torn record."""

@@ -45,10 +45,16 @@ class ModelUnavailable(RuntimeError):
     Scoped to ONE model: the HTTP source answers 503 with Retry-After,
     the spool source defers the file — other models are unaffected."""
 
-    def __init__(self, feature_type: str, retry_after_s: float) -> None:
+    def __init__(
+        self,
+        feature_type: str,
+        retry_after_s: float,
+        reason: Optional[str] = None,
+    ) -> None:
         super().__init__(
-            f"model {feature_type!r} unavailable (circuit breaker open); "
-            f"retry in {retry_after_s:.1f}s"
+            reason
+            or f"model {feature_type!r} unavailable (circuit breaker open); "
+               f"retry in {retry_after_s:.1f}s"
         )
         self.feature_type = feature_type
         self.retry_after_s = float(retry_after_s)
@@ -136,6 +142,29 @@ class CircuitBreaker:
         between two real infra failures must not mask the streak, and
         ignoring it is exactly the point)."""
         with self._lock:
+            self._probing = False
+
+    def trip(self) -> None:
+        """Force-open the breaker (HBM-aware preemption, ISSUE 18): the
+        preemptor evicts a victim extractor to make room for a burst and
+        trips its breaker so the victim's traffic defers (503 / spool
+        backoff) instead of racing an immediate rebuild into the memory
+        it just freed. The re-warm rides the normal cooldown ->
+        half-open -> probe path, so recovery is observable in /healthz
+        exactly like a failure-opened breaker."""
+        with self._lock:
+            self._state = "open"
+            self._opened_at = self._clock()
+            self._probing = False
+            self._opens += 1
+
+    def force_close(self) -> None:
+        """Roll the breaker back to closed (preemption rollback: the
+        beneficiary's build failed, so the victim should serve again
+        without waiting out a cooldown it did nothing to deserve)."""
+        with self._lock:
+            self._state = "closed"
+            self._failures = 0
             self._probing = False
 
     def record_failure(self) -> bool:

@@ -147,12 +147,43 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
         return f
 
     for name, value in sorted(snap.get("counters", {}).items()):
-        if name.startswith("requests_"):
+        # "requests_shed.<reason>" must be matched BEFORE the generic
+        # "requests_" prefix below (it IS a requests_ name)
+        if name.startswith("requests_shed."):
+            fam(
+                f"{METRIC_PREFIX}requests_total", "counter",
+                "Serve requests reaching each lifecycle state (terminal "
+                "states plus admitted/deferred/requeued).",
+            ).add(
+                {"state": "shed", "shed_reason": name[len("requests_shed."):]},
+                value,
+            )
+        elif name.startswith("requests_"):
             fam(
                 f"{METRIC_PREFIX}requests_total", "counter",
                 "Serve requests reaching each lifecycle state (terminal "
                 "states plus admitted/deferred/requeued).",
             ).add({"state": name[len("requests_"):]}, value)
+        elif name.startswith("preemptions."):
+            fam(
+                f"{METRIC_PREFIX}preemptions_total", "counter",
+                "HBM-aware preemptions per evicted feature type (the "
+                "victim's extractor was torn down to fit an "
+                "overcommitting burst; see docs/serving.md \"Fleet "
+                "operation\").",
+            ).add({"feature_type": name[len("preemptions."):]}, value)
+        elif name.startswith("lease_steals."):
+            fam(
+                f"{METRIC_PREFIX}lease_steals_total", "counter",
+                "Spool lease files stolen from dead/stalled replicas, "
+                "per feature type of the reclaimed request.",
+            ).add({"feature_type": name[len("lease_steals."):]}, value)
+        elif name == "lease_expired":
+            fam(
+                f"{METRIC_PREFIX}lease_expired_total", "counter",
+                "Spool leases that aged past --lease_timeout_s without a "
+                "heartbeat and were reclaimed by a surviving replica.",
+            ).add(None, value)
         elif name == "windows_skipped":
             fam(
                 f"{METRIC_PREFIX}windows_skipped_total", "counter",
@@ -187,6 +218,13 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 "device groups not yet fetched; prepared = host-resident "
                 "payloads waiting to dispatch; the backpressure bounds).",
             ).add({"queue": name[len("queue_depth."):]}, value)
+        elif name.startswith("replica_up."):
+            fam(
+                f"{METRIC_PREFIX}replica_up", "gauge",
+                "Fleet membership: 1 when the replica's heartbeat file "
+                "is fresher than --lease_timeout_s, else 0 (survivors "
+                "reclaim a down replica's leases and requests).",
+            ).add({"replica": name[len("replica_up."):]}, value)
         elif name.startswith("device_mem_bytes."):
             # DeviceMemorySampler gauges: "device_mem_bytes.<device>|<kind>"
             # (absent entirely on backends without device.memory_stats())
