@@ -1250,6 +1250,411 @@ def test_explicit_path_gets_hot_patterns(tmp_path):
         assert [f.rule.id for f in found] == ["GC101"], arg
 
 
+# --- GC60x durability contracts ---------------------------------------------
+
+
+def test_gc601_flags_raw_durable_write(tmp_path):
+    """Would-refire pin: the pre-fix serve/sources.py _quarantine shape —
+    a raw write whose target mentions a durable root, no staged rename."""
+    fs = _check(
+        tmp_path,
+        """
+        import json
+        import os
+
+        def publish(root, doc):
+            path = os.path.join(root, "_manifest", "summary.json")
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+        """,
+    )
+    assert _ids(fs) == ["GC601"]
+    assert "_manifest" in fs[0].message and "torn" in fs[0].message
+    assert "atomic_write_json" in fs[0].hint
+
+
+def test_gc601_interprocedural_helper_write(tmp_path):
+    """A helper that raw-writes a parameter path is judged at the caller
+    passing the durable path — with the write site in the trace."""
+    fs = _check(
+        tmp_path,
+        """
+        import json
+
+        def write_doc(path, doc):
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+
+        def publish(root, doc):
+            write_doc(root + "/_requests/rec.json", doc)
+        """,
+    )
+    assert _ids(fs) == ["GC601"]
+    assert "write_doc" in fs[0].message
+    assert len(fs[0].trace) == 2 and "raw write" in fs[0].trace[1]
+
+
+def test_gc601_staged_rename_is_clean(tmp_path):
+    """The tmp-sibling + os.replace shape (io/sink.py atomic_write_json)
+    passes, inline or through a helper that renames."""
+    fs = _check(
+        tmp_path,
+        """
+        import json
+        import os
+
+        def atomic_write(path, doc):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, path)
+
+        def publish(root, doc):
+            atomic_write(os.path.join(root, "_manifest", "summary.json"), doc)
+        """,
+    )
+    assert fs == []
+
+
+def test_gc602_unguarded_claim_sites(tmp_path):
+    """Both claim shapes must branch on losing: O_EXCL create and
+    rename-to-.claim each fire without an enclosing failure handler."""
+    fs = _check(
+        tmp_path,
+        """
+        import os
+
+        def claim_excl(path):
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+
+        def claim_rename(spool, name, rid):
+            os.rename(spool + "/" + name, spool + "/" + name + ".claim." + rid)
+        """,
+    )
+    assert sorted(_ids(fs)) == ["GC602", "GC602", "GC602"]
+    # third finding: the module claims leases but never heartbeats them
+    assert any("O_EXCL" in f.message for f in fs)
+    assert any("assumes victory" in f.message for f in fs)
+    assert any("heartbeat" in f.message for f in fs)
+
+
+def test_gc602_lease_without_heartbeat(tmp_path):
+    """A module that acquires lease files by (guarded) rename but has no
+    os.utime anywhere fires the heartbeat leg of GC602."""
+    fs = _check(
+        tmp_path,
+        """
+        import os
+
+        def poll_once(spool, rid):
+            src = spool + "/job.json"
+            try:
+                os.rename(src, src + ".claim." + rid)
+            except OSError:
+                return None
+            return src
+        """,
+    )
+    assert _ids(fs) == ["GC602"]
+    assert "never" in fs[0].message and "heartbeat" in fs[0].message
+
+
+def test_gc602_heartbeat_reachable_from_poll_is_clean(tmp_path):
+    """The serve/sources.py shape: guarded claim + an os.utime refresh
+    reachable from the poll loop through an exact callee."""
+    fs = _check(
+        tmp_path,
+        """
+        import os
+
+        def _lease_pass(claims):
+            for c in claims:
+                try:
+                    os.utime(c)
+                except OSError:
+                    pass
+
+        def poll_once(spool, rid, claims):
+            _lease_pass(claims)
+            src = spool + "/job.json"
+            try:
+                os.rename(src, src + ".claim." + rid)
+            except OSError:
+                return None
+            return src
+        """,
+    )
+    assert fs == []
+
+
+def test_gc603_bare_rename_and_foreign_tmpdir(tmp_path):
+    """os.rename with no failure branch (publish wants os.replace), and
+    tempfile staging without dir= feeding a rename (EXDEV hazard)."""
+    fs = _check(
+        tmp_path,
+        """
+        import os
+        import tempfile
+
+        def publish(src, dst):
+            os.rename(src, dst)
+
+        def stage(doc, dst):
+            fd, tmp = tempfile.mkstemp()
+            with os.fdopen(fd, "w") as fh:
+                fh.write(doc)
+            os.replace(tmp, dst)
+        """,
+    )
+    assert sorted(_ids(fs)) == ["GC603", "GC603"]
+    assert any("os.replace" in f.message for f in fs)
+    assert any("tmpdir" in f.message for f in fs)
+
+
+def test_gc603_same_dir_tempfile_and_guarded_rename_are_clean(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        import os
+        import tempfile
+
+        def publish(src, dst):
+            try:
+                os.rename(src, dst)
+            except OSError:
+                pass
+
+        def stage(doc, dst):
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dst))
+            with os.fdopen(fd, "w") as fh:
+                fh.write(doc)
+            os.replace(tmp, dst)
+        """,
+    )
+    assert fs == []
+
+
+# --- GC70x observability contracts -------------------------------------------
+
+_EXPO_FIXTURE = textwrap.dedent(
+    """
+    _PLAIN_COUNTERS = {"frames_seen": "Frames seen."}
+
+    def families_from_snapshot(snap):
+        out = []
+        for name, value in snap.get("counters", {}).items():
+            if name.startswith("requests_"):
+                out.append(("requests_total", value))
+            elif name == "lease_expired":
+                out.append(("lease_expired_total", value))
+            elif name in _PLAIN_COUNTERS:
+                out.append((name, value))
+        return out
+    """
+)
+
+
+def _check_two(tmp_path, expo, producer):
+    (tmp_path / "expo.py").write_text(textwrap.dedent(expo))
+    (tmp_path / "prod.py").write_text(textwrap.dedent(producer))
+    return run_checks([str(tmp_path)])
+
+
+def test_gc701_orphan_producer_fires(tmp_path):
+    """Would-refire pin: the pre-fix in-tree shape — a registry series
+    (inc/set_gauge/f-string prefix) no exposition convention maps, like
+    'frames_decoded' before the _PLAIN_COUNTERS table existed."""
+    fs = _check_two(
+        tmp_path,
+        _EXPO_FIXTURE,
+        """
+        class Worker:
+            def tick(self, status):
+                self.metrics.inc("ghost_series")
+                self.metrics.inc("frames_seen")
+                self.metrics.inc(f"requests_{status}")
+                self.metrics.inc("lease_expired")
+        """,
+    )
+    assert _ids(fs) == ["GC701"]
+    assert "ghost_series" in fs[0].message and "fallback" in fs[0].message
+    assert fs[0].trace and "families_from_snapshot" in fs[0].trace[0]
+
+
+def test_gc701_orphan_family_fires_reverse(tmp_path):
+    """A convention nothing produces (== exact, startswith prefix, or a
+    _PLAIN_* table entry) is an orphaned family."""
+    fs = _check_two(
+        tmp_path,
+        _EXPO_FIXTURE,
+        """
+        class Worker:
+            def tick(self):
+                self.metrics.inc("frames_seen")
+                self.metrics.inc("requests_done")
+        """,
+    )
+    # 'lease_expired' has no producer in this sweep
+    assert _ids(fs) == ["GC701"]
+    assert "lease_expired" in fs[0].message and "no producer" in fs[0].message
+
+
+def test_gc701_mapped_producers_are_clean_and_gated(tmp_path):
+    fs = _check_two(
+        tmp_path,
+        _EXPO_FIXTURE,
+        """
+        class Worker:
+            def tick(self, status):
+                self.metrics.inc("frames_seen")
+                self.metrics.inc(f"requests_{status}")
+                self.metrics.inc("lease_expired")
+        """,
+    )
+    assert fs == []
+    # no exposition module in the sweep -> the contract has no anchor
+    fs = _check(
+        tmp_path,
+        """
+        class W:
+            def t(self):
+                self.metrics.inc("anything_at_all")
+        """,
+        name="lone.py",
+    )
+    assert [f for f in fs if f.rule.id == "GC701"] == []
+
+
+def test_gc702_unknown_and_dead_stages(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        STAGES = ("decode", "ghost")
+
+        def drill(fire):
+            fire("decode")
+            fire("typo")
+        """,
+    )
+    assert sorted(_ids(fs)) == ["GC702", "GC702"]
+    assert any("'typo'" in f.message and "not declared" in f.message for f in fs)
+    assert any("'ghost'" in f.message and "no fire() site" in f.message for f in fs)
+
+
+def test_gc702_matched_stages_are_clean(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        STAGES = ("decode", "sink")
+
+        def drill(fire):
+            fire("decode")
+            fire("sink")
+        """,
+    )
+    assert fs == []
+
+
+_CONFIG_FIXTURE_BAD = """
+    import argparse
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Cfg:
+        alpha: str = ""
+        hidden: int = 0
+
+    def build():
+        p = argparse.ArgumentParser()
+        p.add_argument("--alpha")
+        p.add_argument("--ghost")
+        return p
+
+    def sanity_check(cfg):
+        if not cfg.alhpa:
+            raise ValueError("alpha required")
+        return cfg
+"""
+
+
+def test_gc703_flag_field_sanity_drift(tmp_path):
+    """Would-refire pin: every pre-fix config.py shape at once — a flag
+    parsing into nothing (--ghost), a free-form flag nobody validates
+    (--alpha, the pre-fix --extract_method), a field no flag can set
+    (hidden, the pre-fix shape_buckets), and a sanity-check typo."""
+    fs = _check(tmp_path, _CONFIG_FIXTURE_BAD, name="config.py")
+    assert _ids(fs) == ["GC703"] * 4
+    msgs = "\n".join(f.message for f in fs)
+    assert "--ghost" in msgs and "goes nowhere" in msgs
+    assert "--alpha" in msgs and "no parser-side constraint" in msgs
+    assert "'hidden'" in msgs and "never be set from the CLI" in msgs
+    assert "cfg.alhpa" in msgs and "typo" in msgs
+
+
+def test_gc703_wired_config_is_clean(tmp_path):
+    fs = _check(
+        tmp_path,
+        """
+        import argparse
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Cfg:
+            alpha: str = ""
+            hidden: int = 0
+
+        def build():
+            p = argparse.ArgumentParser()
+            p.add_argument("--alpha")
+            return p
+
+        def parse(argv=None):
+            args = build().parse_args(argv)
+            return sanity_check(Cfg(alpha=args.alpha, hidden=1))
+
+        def sanity_check(cfg):
+            if not cfg.alpha.strip():
+                raise ValueError("alpha required")
+            return cfg
+        """,
+        name="config.py",
+    )
+    assert fs == []
+
+
+def test_gc703_only_fires_on_config_modules(tmp_path):
+    """The contract is anchored to config.py: the same drift in any other
+    module (an ad-hoc argparse in a script) is out of scope."""
+    fs = _check(tmp_path, _CONFIG_FIXTURE_BAD, name="tool.py")
+    assert [f for f in fs if f.rule.id == "GC703"] == []
+
+
+def test_new_rules_render_in_sarif_with_fix_hints(tmp_path):
+    """Every GC60x/GC70x id reaches SARIF: in the driver catalogue, and
+    as a result whose message folds the fix hint."""
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(
+        """
+        import json
+        import os
+
+        def publish(root, doc):
+            with open(root + "/_manifest/s.json", "w") as fh:
+                json.dump(doc, fh)
+        """
+    ))
+    r = _cli("--sarif", str(bad))
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    catalogue = {ru["id"] for ru in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"GC601", "GC602", "GC603", "GC701", "GC702", "GC703"} <= catalogue
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "GC601"
+    assert "(fix:" in res["message"]["text"]
+    assert "atomic_write_json" in res["message"]["text"]
+
+
 def test_repo_is_clean():
     """`python -m video_features_tpu.analysis` exits 0 on the repo: every
     genuine violation is fixed, every intentional one carries an
@@ -1262,7 +1667,9 @@ def test_rule_catalogue_complete():
     assert ids == ["GC101", "GC102", "GC103", "GC104",
                    "GC201", "GC202", "GC203",
                    "GC301", "GC311", "GC312", "GC313", "GC401",
-                   "GC501", "GC502", "GC503", "GC504", "GC505"]
+                   "GC501", "GC502", "GC503", "GC504", "GC505",
+                   "GC601", "GC602", "GC603",
+                   "GC701", "GC702", "GC703"]
 
 
 def _cli(*args, cwd=REPO):

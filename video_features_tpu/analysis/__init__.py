@@ -1,7 +1,7 @@
 """graftcheck — the repo's static-analysis suite (docs/analysis.md).
 
-Four checkers turn the design rules the hot path depends on into tier-1
-test failures instead of review-time folklore:
+The checker families turn the design rules the hot path and the fleet
+depend on into tier-1 test failures instead of review-time folklore:
 
 - GC10x host-sync lint (:mod:`.hostsync`) — no hidden device->host
   syncs inside the per-video hot loop.
@@ -11,9 +11,20 @@ test failures instead of review-time folklore:
 - GC301 thread-safety lint (:mod:`.thread_safety`) — module-level
   mutable state on thread-reachable paths is locked, thread-local, or
   explicitly waived.
+- GC31x concurrency lint (:mod:`.concurrency`) — lock ordering, no
+  blocking I/O or waits under a held lock on dispatch paths.
 - GC401 recompilation budget (:mod:`.compile_budget`) — a runtime
   tracer pins executable counts per extractor to
   ``analysis/compile_budget.json``.
+- GC50x sharding contract (:mod:`.sharding_contract`) — mesh entries
+  declare shardings that exist, mesh-capable models keep their specs.
+- GC60x durability contracts (:mod:`.durability`) — durable publishes
+  stage-then-``os.replace``, claim/lease sites branch on losing and
+  heartbeat what they hold, renames carry the right semantics.
+- GC70x observability contracts (:mod:`.obs_contract`) — every metric
+  name maps to a curated exposition family (and every family has a
+  producer), fault stages match ``fire()`` sites both directions, and
+  config.py's flags / dataclass fields / sanity checks stay in sync.
 
 Run ``python -m video_features_tpu.analysis`` (CLI) or
 ``pytest -m analysis`` (tier-1). Waive individual findings with inline

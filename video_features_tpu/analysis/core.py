@@ -384,8 +384,10 @@ def run_checks(
     ``rules`` filters to findings whose rule id/name matches any token."""
     from video_features_tpu.analysis import (
         concurrency,
+        durability,
         hostsync,
         jit_hygiene,
+        obs_contract,
         sharding_contract,
         thread_safety,
     )
@@ -405,6 +407,8 @@ def run_checks(
     findings.extend(thread_safety.check(sources, graph))
     findings.extend(concurrency.check(sources, graph, project))
     findings.extend(sharding_contract.check(sources, graph))
+    findings.extend(durability.check(sources, graph, project))
+    findings.extend(obs_contract.check(sources))
 
     kept = []
     for f in findings:
@@ -421,8 +425,10 @@ def run_checks(
 def all_rules() -> List[Rule]:
     from video_features_tpu.analysis import (
         concurrency,
+        durability,
         hostsync,
         jit_hygiene,
+        obs_contract,
         sharding_contract,
         thread_safety,
     )
@@ -435,4 +441,6 @@ def all_rules() -> List[Rule]:
         *concurrency.RULES.values(),
         BUDGET_RULE,
         *sharding_contract.RULES.values(),
+        *durability.RULES.values(),
+        *obs_contract.RULES.values(),
     ]

@@ -500,6 +500,39 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
         raise ValueError(
             f"ingest_cache_mb must be >= 0, got {cfg.ingest_cache_mb}"
         )
+    # flag-surface hygiene (graftcheck GC703): every free-form string
+    # flag gets at least a shape check here, so junk values fail at
+    # parse time instead of deep inside a run
+    for flag, val in (
+        ("file_with_video_paths", cfg.file_with_video_paths),
+        ("video_dir", cfg.video_dir),
+        ("flow_dir", cfg.flow_dir),
+        ("weights_path", cfg.weights_path),
+        ("profile_dir", cfg.profile_dir),
+        ("compile_cache", cfg.compile_cache),
+        ("cache_dir", cfg.cache_dir),
+    ):
+        if val is not None and not str(val).strip():
+            raise ValueError(f"--{flag} must be a non-empty path")
+    for flag, paths in (
+        ("video_paths", cfg.video_paths),
+        ("flow_paths", cfg.flow_paths),
+    ):
+        if paths and any(not str(pth).strip() for pth in paths):
+            raise ValueError(f"--{flag} contains an empty path")
+    if cfg.extract_method is not None and not re.fullmatch(
+        r"(uni|fix)_[0-9]+", cfg.extract_method
+    ):
+        raise ValueError(
+            "extract_method must look like uni_<N> or fix_<fps> (the "
+            f"io/video.py samplers), got {cfg.extract_method!r}"
+        )
+    if cfg.shape_buckets is not None and (
+        not cfg.shape_buckets or any(b < 1 for b in cfg.shape_buckets)
+    ):
+        raise ValueError(
+            f"shape_buckets must be positive ints, got {cfg.shape_buckets}"
+        )
     return cfg
 
 
@@ -631,6 +664,10 @@ def build_arg_parser(feature_required: bool = True) -> argparse.ArgumentParser:
                         "axis up to a multiple of this before compiling "
                         "(O(buckets) executables on mixed-resolution "
                         "corpora, not O(shapes))")
+    p.add_argument("--shape_buckets", type=int, nargs="+", default=None,
+                   help="explicit resolution bucket edges for XLA static "
+                        "shapes (ops/window.py); default derives buckets "
+                        "per extractor instead of from a fixed list")
     p.add_argument("--compile_cache", type=str, default=None,
                    help="persistent XLA compilation cache dir "
                         "(jax_compilation_cache_dir): repeat runs skip "
@@ -1020,6 +1057,10 @@ def sanity_check_serve(scfg: ServeConfig) -> ServeConfig:
             raise ValueError(f"unknown feature_type in --feature_types: {ft!r}")
         # fail at startup, not on the first request of that type
         sanity_check(scfg.extraction.replace(feature_type=ft))
+    if not str(scfg.host).strip():
+        raise ValueError("--host must be a non-empty bind address")
+    if scfg.spool_dir is not None and not str(scfg.spool_dir).strip():
+        raise ValueError("--spool_dir must be a non-empty path")
     if scfg.max_group_size < 1:
         raise ValueError(f"max_group_size must be >= 1, got {scfg.max_group_size}")
     if scfg.max_queue < 1:

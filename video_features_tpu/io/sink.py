@@ -10,13 +10,14 @@ int) is implemented correctly here for 2-channel flow features.
 
 from __future__ import annotations
 
+import json
 import os
 import pathlib
 import pickle
 import shutil
 import threading
 import uuid
-from typing import Dict, List, Union
+from typing import Any, Dict, List, Union
 
 import numpy as np
 
@@ -46,6 +47,37 @@ def atomic_copy(src: str, dest: str) -> None:
         except OSError:
             pass
         raise
+
+
+def atomic_write_json(
+    path: str,
+    doc: Any,
+    *,
+    indent: Union[int, None] = None,
+    sort_keys: bool = False,
+) -> str:
+    """Publish ``doc`` as JSON at ``path`` with the commit protocol every
+    durable root in the tree uses (graftcheck GC601): stage to a
+    uniquely-named same-directory ``.tmp`` sibling, then one
+    ``os.replace``. Readers either see the old complete file or the new
+    complete file — never a torn one — and concurrent writers can't
+    clobber each other's staging file. Returns ``path``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = (
+        f"{path}.{os.getpid()}-{threading.get_ident()}"
+        f"-{uuid.uuid4().hex[:8]}.tmp"
+    )
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=indent, sort_keys=sort_keys)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 
 def output_file_name(name: str, key: str, on_extraction: str, output_direct: bool) -> str:

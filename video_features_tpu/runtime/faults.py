@@ -576,11 +576,11 @@ def finalize_run(output_root: str) -> Optional[Dict[str, Any]]:
             summary["telemetry"] = tblock
     except Exception as e:  # noqa: BLE001 - keep the manifest writable
         summary["telemetry_error"] = repr(e)
+    # lazy import: io/sink.py imports this module for fault injection
+    from video_features_tpu.io.sink import atomic_write_json
+
     path = os.path.join(manifest_dir(output_root), SUMMARY_BASENAME)
-    tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(summary, fh, indent=1, sort_keys=True)
-    os.replace(tmp, path)
+    atomic_write_json(path, summary, indent=1, sort_keys=True)
     return summary
 
 

@@ -592,13 +592,12 @@ class Telemetry:
                 f.write(json.dumps(r, default=str) + "\n")
             f.flush()
         if self._metrics_path is not None:
+            from video_features_tpu.io.sink import atomic_write_json
+
             snap = self.metrics.snapshot()
             snap["run"] = self.run_id
             snap["buckets_seen"] = self.buckets_seen()
-            tmp = self._metrics_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(snap, f)
-            os.replace(tmp, self._metrics_path)
+            atomic_write_json(self._metrics_path, snap)
 
     def maybe_heartbeat(self) -> None:
         if self._next_heartbeat is None or time.monotonic() < self._next_heartbeat:

@@ -213,12 +213,9 @@ class ServiceTimeModel:
                 "weight_classes": {k: [e.value, e.n] for k, e in self._classes.items()},
                 "global": [self._global.value, self._global.n],
             }
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)
-        return path
+        from video_features_tpu.io.sink import atomic_write_json
+
+        return atomic_write_json(path, doc)
 
     def _load(self, path: str) -> None:
         try:

@@ -35,6 +35,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from video_features_tpu.io.sink import atomic_write_json
 from video_features_tpu.runtime import faults as faults_mod
 from video_features_tpu.runtime.faults import RunManifest
 
@@ -191,12 +192,11 @@ class ReplicaRegistry:
         """Refresh this replica's heartbeat (tmp + rename: a reader never
         sees a torn file, and the rename refreshes mtime atomically)."""
         try:
-            os.makedirs(self.dir, exist_ok=True)
-            tmp = f"{self.path}.{os.getpid()}.tmp"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump({"replica": self.replica_id, "pid": os.getpid(),
-                           "ts": round(time.time(), 3)}, fh)
-            os.replace(tmp, self.path)
+            atomic_write_json(
+                self.path,
+                {"replica": self.replica_id, "pid": os.getpid(),
+                 "ts": round(time.time(), 3)},
+            )
         except OSError:
             pass  # a missed beat is survivable; a crashed beat is not
 
@@ -476,11 +476,7 @@ class RequestTracker:
             # the latency budget restarts on re-admission: a requeued
             # request gets a fresh window, not an instant expiry
             payload["deadline_ms"] = float(req.deadline_ms)
-        os.makedirs(spool_dir, exist_ok=True)
-        tmp = os.path.join(spool_dir, f".requeue-{req.id}.{uuid.uuid4().hex[:6]}.tmp")
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(payload, fh)
-        os.replace(tmp, os.path.join(spool_dir, f"{req.id}.json"))
+        atomic_write_json(os.path.join(spool_dir, f"{req.id}.json"), payload)
         with self._lock:
             self._records.pop(req.id, None)
             token = self._spans.pop(req.id, None)
@@ -687,9 +683,5 @@ class RequestTracker:
     def _write_result(self, rec: Dict[str, Any]) -> None:
         """tmp + rename so a status reader never sees a torn record."""
         faults_mod.fire("tracker_write")
-        os.makedirs(self.results_dir, exist_ok=True)
         path = os.path.join(self.results_dir, f"{rec['id']}.json")
-        tmp = f"{path}.{os.getpid()}.{uuid.uuid4().hex[:6]}.tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(rec, fh, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        atomic_write_json(path, rec, indent=1, sort_keys=True)

@@ -412,10 +412,14 @@ class SpoolWatcher:
 
     def _quarantine(self, claimed: str, name: str, exc: Exception) -> None:
         bad = os.path.join(self.spool_dir, name + ".bad")
+        why_tmp = bad + ".why.tmp"
         try:
             os.replace(claimed, bad)
-            with open(bad + ".why", "w", encoding="utf-8") as fh:
+            # staged like every durable publish (GC601): the .why sidecar
+            # is what an operator reads to triage, so it must never be torn
+            with open(why_tmp, "w", encoding="utf-8") as fh:
                 fh.write(f"{type(exc).__name__}: {exc}\n")
+            os.replace(why_tmp, bad + ".why")
         except OSError:
             pass
         print(f"serve: spool file {name} rejected: {exc}")

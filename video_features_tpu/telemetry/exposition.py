@@ -48,6 +48,61 @@ _SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
 GROUP_SERVICE_SEP = "|"
 
 
+# Unlabelled registry series with curated HELP text. Every producer-side
+# metric name must map to a family here or to one of the labelled
+# branches in families_from_snapshot — the sanitized fallback renders a
+# name nobody documented, and graftcheck GC701 flags producers that
+# would land there (and table entries nothing produces).
+_PLAIN_COUNTERS = {
+    "frames_decoded": (
+        "Video frames decoded across all decode workers (sampled frames "
+        "entering the host pipeline, not raw container frames)."
+    ),
+    "h2d_bytes": (
+        "Bytes staged host-to-device through the async ingest "
+        "double-buffer (docs/tpu.md)."
+    ),
+    "videos_done": (
+        "Videos fully extracted and committed by the sink (resume-safe "
+        "completions, not attempts)."
+    ),
+    "compiles": (
+        "XLA compilations observed by RecompileWatch — growth after "
+        "warmup means a shape leaked past bucketing."
+    ),
+    "retries": (
+        "Per-video extraction retries after a retryable worker failure "
+        "(--max_retries bounds these per video)."
+    ),
+    "groups_dispatched": (
+        "Fused request groups handed to a device executor by the "
+        "serve batcher."
+    ),
+    "deadline_missed": (
+        "Requests that finished after their --deadline_ms budget "
+        "(completed late, not dropped)."
+    ),
+}
+_PLAIN_GAUGES = {
+    "buckets_seen": (
+        "Distinct shape buckets observed this run — the compile-surface "
+        "cardinality the bucketing policy is holding."
+    ),
+    "groups_inflight": (
+        "1 while a fused group occupies the device executor, else 0 "
+        "(single-executor dispatch; see docs/serving.md)."
+    ),
+    "queue_age_oldest_s": (
+        "Age in seconds of the oldest request waiting in the batcher "
+        "queue — the head-of-line latency the scheduler is quoting."
+    ),
+    "device_mem_headroom_bytes": (
+        "HBM budget minus the cost ledger's resident-bytes projection "
+        "(what the preemptor spends; negative means overcommit)."
+    ),
+}
+
+
 def group_service_metric(feature_type: str, bucket: str) -> str:
     """The registry histogram name for one (feature_type, bucket) group
     service-time series (daemon observes it; /metrics renders it)."""
@@ -204,6 +259,11 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 "Content-addressed feature cache misses per feature type "
                 "(extraction ran and populated the store).",
             ).add({"feature_type": name[len("cache_miss."):]}, value)
+        elif name in _PLAIN_COUNTERS:
+            fam(
+                f"{METRIC_PREFIX}{name}_total", "counter",
+                _PLAIN_COUNTERS[name],
+            ).add(None, value)
         else:
             fam(
                 f"{METRIC_PREFIX}{sanitize_metric_name(name)}_total", "counter",
@@ -237,6 +297,11 @@ def families_from_snapshot(snap: Dict[str, Any]) -> List[Family]:
                 "peak/reserved), polled from device.memory_stats(); "
                 "absent on backends without the API.",
             ).add({"device": dev, "kind": kind or "~"}, value)
+        elif name in _PLAIN_GAUGES:
+            fam(
+                f"{METRIC_PREFIX}{name}", "gauge",
+                _PLAIN_GAUGES[name],
+            ).add(None, value)
         else:
             fam(
                 f"{METRIC_PREFIX}{sanitize_metric_name(name)}", "gauge",

@@ -278,12 +278,9 @@ class CostLedger:
             return None
         with self._lock:
             doc = {"version": SCHEMA_VERSION, "entries": dict(self._entries)}
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)
-        return path
+        from video_features_tpu.io.sink import atomic_write_json
+
+        return atomic_write_json(path, doc)
 
     def _load(self, path: str) -> None:
         try:
