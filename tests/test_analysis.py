@@ -1220,6 +1220,24 @@ def test_budget_scenarios_match_committed_json():
         assert sc.description == budget[name]["description"], name
 
 
+def test_bf16_budget_scenarios_match_fp32_twins():
+    """The GC401 dtype axis: each *_bf16 scenario must exist and pin the
+    SAME executable ceiling as its fp32 twin — bf16 swaps the compiled
+    program, it must never multiply programs (a second executable per
+    dtype would double compile latency and HBM program space)."""
+    from video_features_tpu.analysis.compile_budget import load_budget
+
+    budget = load_budget()
+    twins = {
+        "clip_device_mixed_bf16": "clip_device_mixed",
+        "raft_device_tiny_bf16": "raft_device_tiny",
+        "pwc_device_tiny_bf16": "pwc_device_tiny",
+    }
+    for bf16, fp32 in twins.items():
+        assert bf16 in budget, bf16
+        assert budget[bf16]["max_compiles"] == budget[fp32]["max_compiles"], bf16
+
+
 def test_budget_covers_every_device_preprocess_family():
     """The GC401 satellite: RAFT/PWC and I3D device scenarios exist
     alongside CLIP's — the budget follows --preprocess device coverage,
@@ -1648,11 +1666,513 @@ def test_new_rules_render_in_sarif_with_fix_hints(tmp_path):
     assert r.returncode == 1
     doc = json.loads(r.stdout)
     catalogue = {ru["id"] for ru in doc["runs"][0]["tool"]["driver"]["rules"]}
-    assert {"GC601", "GC602", "GC603", "GC701", "GC702", "GC703"} <= catalogue
+    assert {"GC601", "GC602", "GC603", "GC701", "GC702", "GC703",
+            "GC801", "GC802", "GC803", "GC804", "GC805"} <= catalogue
     (res,) = doc["runs"][0]["results"]
     assert res["ruleId"] == "GC601"
     assert "(fix:" in res["message"]["text"]
     assert "atomic_write_json" in res["message"]["text"]
+
+
+# --- GC80x numerics & dtype-flow -------------------------------------------
+
+PK = "# graftcheck: pallas-kernel\n"
+
+
+def _gc8(findings, rule=None):
+    return [
+        f for f in findings
+        if f.rule.id.startswith("GC8") and (rule is None or f.rule.id == rule)
+    ]
+
+
+def _clear_tests_text_cache():
+    from video_features_tpu.analysis import numerics
+
+    numerics._TESTS_TEXT_CACHE.clear()
+
+
+def test_promotion_flags_f64_constructs_in_jit(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def hot(x):
+            scale = np.float64(2.0)
+            bias = np.zeros((4,))
+            return x * scale + bias
+        """,
+    ), "GC801")
+    assert len(fs) == 2
+    assert any("float64 scalar" in f.message for f in fs)
+    assert any("defaults to float64" in f.message for f in fs)
+    assert all("jit" in " ".join(f.trace) for f in fs)
+
+
+def test_promotion_interprocedural_return_trace(tmp_path):
+    """A helper RETURNING an f64 value is flagged at its jit-side
+    caller, construct site leading the via: trace (the tentpole's
+    interprocedural leg)."""
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        def _grid():
+            return np.linspace(0.0, 1.0, 16)
+
+        @jax.jit
+        def hot(x):
+            return x + _grid()
+        """,
+    ), "GC801")
+    assert len(fs) == 1
+    (f,) = fs
+    assert "_grid" in f.message and "returns float64" in f.message
+    assert f.line == 10  # the CALL site, not the construct site
+    assert any("linspace" in step for step in f.trace)
+    assert any("jitted entry" in step for step in f.trace)
+
+
+def test_promotion_good_and_islanded(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def hot(x):
+            bias = np.zeros((4,), dtype=np.float32)
+            # graftcheck: fp32-island — host-side f64 quadrature weights,
+            # cast before they meet traced values
+            w = np.linspace(0.0, 1.0, 16)
+            return x * np.float32(2.0) + bias + w.astype(np.float32)
+        """,
+    ), "GC801")
+    assert fs == []
+
+
+def test_accum_dtype_flags_unpinned_matmul_and_softmax(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        class Block:
+            dtype: jnp.dtype = jnp.float32
+
+            def __call__(self, x):
+                w = jnp.ones((4, 4), dtype=self.dtype)
+                y = jnp.einsum("ij,jk->ik", x, w)
+                return jax.nn.softmax(y, axis=-1)
+        """,
+    ), "GC802")
+    assert len(fs) == 2
+    assert any("einsum" in f.message for f in fs)
+    assert any("softmax" in f.message for f in fs)
+    assert all("'__call__'" in f.message for f in fs)
+
+
+def test_accum_dtype_reaches_helpers_with_trace(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def _norm(v):
+            return v / jnp.linalg.norm(v)
+
+        def entry(x, dtype=jnp.float32):
+            return _norm(x.astype(dtype))
+        """,
+    ), "GC802")
+    assert len(fs) == 1
+    (f,) = fs
+    assert "norm" in f.message and "'entry'" in f.message
+    assert any("bf16-polymorphic entry" in step for step in f.trace)
+
+
+def test_accum_dtype_election_passes_matmul_not_softmax(tmp_path):
+    """Casting operands to the entry's own dtype is a visible precision
+    election for MXU matmuls (they accumulate f32 internally) — but no
+    pass for sensitive reductions."""
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        class Conv:
+            dtype: jnp.dtype = jnp.float32
+
+            def __call__(self, x, w):
+                x = x.astype(self.dtype)
+                w = w.astype(self.dtype)
+                y = jax.lax.dot(x, w)
+                return jax.nn.softmax(y, axis=-1)
+        """,
+    ), "GC802")
+    assert len(fs) == 1 and "softmax" in fs[0].message
+
+
+def test_accum_dtype_good_pins_and_island(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        HIGHEST = jax.lax.Precision.HIGHEST
+
+        class Block:
+            dtype: jnp.dtype = jnp.float32
+
+            def __call__(self, x):
+                w = jnp.ones((4, 4), dtype=self.dtype)
+                hp = jax.lax.Precision.HIGHEST
+                y = jnp.einsum("ij,jk->ik", x, w, precision=hp)
+                z = jax.nn.softmax(y.astype(jnp.float32), axis=-1)
+                return z.mean(axis=-1, dtype=jnp.float32)
+
+        # graftcheck: fp32-island — callers pin the carry fp32 upstream
+        def stats(x, dtype=jnp.float32):
+            return x.mean(), x.var()
+        """,
+    ), "GC802")
+    assert fs == []
+
+
+def test_accum_dtype_bf16_entry_token_widens(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import jax
+
+        # graftcheck: bf16-entry — activations arrive in caller dtype
+        def attention_core(q):
+            return jax.nn.softmax(q, axis=-1)
+        """,
+    ), "GC802")
+    assert len(fs) == 1 and "softmax" in fs[0].message
+
+
+def test_cast_discipline_flags_host_f32_on_frames(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import numpy as np
+
+        def prepare(frames):
+            return frames.astype(np.float32)
+        """,
+        prefix=HOT,
+    ), "GC803")
+    assert len(fs) == 1 and "4x the uint8 wire bytes" in fs[0].message
+
+
+def test_cast_discipline_flags_np_wrapper(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import numpy as np
+
+        def stack_windows(clip_list):
+            return np.asarray(clip_list, dtype=np.float32)
+        """,
+        prefix=HOT,
+    ), "GC803")
+    assert len(fs) == 1
+
+
+def test_cast_discipline_good_device_dtype_and_island(tmp_path):
+    """A jnp.float32 target implies a device-side cast (GC802's business,
+    e.g. the RAFT corr-pyramid pins); islands cover host parity paths."""
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def pin_on_device(frames):
+            return frames.astype(jnp.float32)
+
+        # graftcheck: fp32-island — host-only PIL parity reference
+        def reference(frames):
+            return frames.astype(np.float32)
+
+        def wire(frames):
+            return np.ascontiguousarray(frames)  # uint8 stays uint8
+        """,
+        prefix=HOT,
+    ), "GC803")
+    assert fs == []
+
+
+def test_parity_coverage_requires_admission_table(tmp_path):
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import argparse
+
+        def build_parser():
+            p = argparse.ArgumentParser()
+            p.add_argument("--dtype", choices=["float32", "bfloat16"])
+            return p
+        """,
+        name="config.py",
+    ), "GC804")
+    assert len(fs) == 1
+    assert "LOW_PRECISION_MODEL_FAMILIES" in fs[0].message
+
+
+def test_parity_coverage_requires_budget_file_entry_and_test(tmp_path):
+    _clear_tests_text_cache()
+    cfg = 'LOW_PRECISION_MODEL_FAMILIES = {"bfloat16": ("raft", "pwc")}\n'
+    fs = _gc8(_check(tmp_path, cfg, name="config.py"), "GC804")
+    assert len(fs) == 1 and "parity_budget.json" in fs[0].message
+
+    adir = tmp_path / "analysis"
+    adir.mkdir()
+    (adir / "parity_budget.json").write_text(json.dumps(
+        {"raft": {"bfloat16": {"model": {"max_rel": 0.02}}}}
+    ))
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_nothing.py").write_text("def test_a(): pass\n")
+    fs = _gc8(_check(tmp_path, cfg, name="config.py"), "GC804")
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 2
+    assert any("('pwc', 'bfloat16') has no max_rel" in m for m in msgs)
+    assert any(
+        "('raft', 'bfloat16') has a parity budget but no e2e test" in m
+        for m in msgs
+    )
+
+
+def test_parity_coverage_good_and_orphan(tmp_path):
+    _clear_tests_text_cache()
+    cfg = 'LOW_PRECISION_MODEL_FAMILIES = {"bfloat16": ("raft",)}\n'
+    adir = tmp_path / "analysis"
+    adir.mkdir()
+    (adir / "parity_budget.json").write_text(json.dumps(
+        {"raft": {"bfloat16": {"model": {"max_rel": 0.02}}}}
+    ))
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_parity.py").write_text(
+        'def test_raft_drift():\n'
+        '    assert_drift_within("raft", "bfloat16", "model", a, b)\n'
+    )
+    assert _gc8(_check(tmp_path, cfg, name="config.py"), "GC804") == []
+
+    _clear_tests_text_cache()
+    (adir / "parity_budget.json").write_text(json.dumps({
+        "raft": {"bfloat16": {"model": {"max_rel": 0.02}}},
+        "pwc": {"bfloat16": {"model": {"max_rel": 0.02}}},
+    }))
+    fs = _gc8(_check(tmp_path, cfg, name="config.py"), "GC804")
+    assert len(fs) == 1 and "orphan parity budget" in fs[0].message
+
+
+def test_pallas_hygiene_flags_accumulator_grid_interpret(tmp_path):
+    """The kitchen-sink bad kernel: bf16 scratch accumulator, unpinned
+    reduction, //-grid without guard, no interpret= exposure — and the
+    kernel is bound through the idiomatic local functools.partial."""
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _kernel(x_ref, o_ref, acc):
+            acc[...] += jnp.sum(x_ref[...])
+            o_ref[...] = acc[...]
+
+        def launch_fixture(x):
+            kernel = functools.partial(_kernel)
+            return pl.pallas_call(
+                kernel,
+                grid=(x.shape[0] // 8,),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.bfloat16)],
+            )(x)
+        """,
+        prefix=PK,
+    ), "GC805")
+    msgs = " | ".join(f.message for f in fs)
+    assert "accumulator scratch 'acc'" in msgs and "not float32" in msgs
+    assert "sum in kernel '_kernel' accumulates in the input dtype" in msgs
+    assert "no divisibility guard" in msgs
+    assert "exposes no interpret=" in msgs
+
+
+def test_pallas_hygiene_flags_nonscratch_accum_and_cdiv(tmp_path):
+    _clear_tests_text_cache()
+    (tmp_path / "tests").mkdir()  # nearest tests dir: empty, no parity test
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _kernel2(x_ref, o_ref):
+            o_ref[...] = o_ref[...] + x_ref[...]
+
+        def launch_fixture2(x, interpret=False):
+            return pl.pallas_call(
+                _kernel2,
+                grid=(pl.cdiv(x.shape[0], 8),),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=interpret,
+            )(x)
+        """,
+        prefix=PK,
+    ), "GC805")
+    msgs = " | ".join(f.message for f in fs)
+    assert "accumulates into non-scratch ref 'o_ref'" in msgs
+    assert "rounds up but nothing pads" in msgs
+    assert "no interpret-mode parity test exercises 'launch_fixture2'" in msgs
+
+
+def test_pallas_hygiene_good_kernel(tmp_path):
+    _clear_tests_text_cache()
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_kernel.py").write_text(
+        "def test_parity():\n"
+        "    launch_fixture3(x, interpret=True)\n"
+    )
+    fs = _gc8(_check(
+        tmp_path,
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _kernel3(x_ref, o_ref, acc):
+            acc[...] += jnp.sum(x_ref[...], dtype=jnp.float32)
+            o_ref[...] = acc[...].astype(o_ref.dtype)
+
+        def launch_fixture3(x, interpret=False):
+            pad = (-x.shape[0]) % 8
+            x = jnp.pad(x, ((0, pad), (0, 0)))
+            kernel = functools.partial(_kernel3)
+            return pl.pallas_call(
+                kernel,
+                grid=(pl.cdiv(x.shape[0], 8),),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+                interpret=interpret,
+            )(x)
+        """,
+        prefix=PK,
+    ), "GC805")
+    assert fs == []
+
+
+def test_declaration_tokens_are_not_waivers(tmp_path):
+    """fp32-island / bf16-entry / pallas-kernel declare facts for GC80x;
+    they must not silence any OTHER rule (zero-waiver policy intact)."""
+    fs = _check(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def hot(x):
+            # graftcheck: fp32-island — declarations are not waivers
+            return float(jnp.square(x))
+        """,
+        prefix=HOT,
+    )
+    assert "GC102" in _ids(fs)
+
+
+# --- GC80x would-refire pins for the in-tree fixes --------------------------
+
+def test_raft_softmax_pin_would_refire(tmp_path):
+    """THE acceptance pin: stripping the fp32 cast from RAFT's
+    bf16-reachable upsample softmax refires GC802 and fails tier-1."""
+    src_path = os.path.join(
+        REPO, "video_features_tpu", "models", "raft", "model.py"
+    )
+    with open(src_path, encoding="utf-8") as fh:
+        src = fh.read()
+    pinned = "mask.reshape(N, H, W, 9, 8, 8).astype(jnp.float32)"
+    assert pinned in src, "the GC802 softmax pin left raft/model.py"
+    stripped = src.replace(pinned, "mask.reshape(N, H, W, 9, 8, 8)")
+    p = tmp_path / "model.py"
+    p.write_text(stripped)
+    fs = [f for f in run_checks([str(p)]) if f.rule.id == "GC802"]
+    assert any("softmax" in f.message for f in fs)
+    # control: the shipped source is clean
+    assert [f for f in run_checks([src_path]) if f.rule.id == "GC802"] == []
+
+
+def test_correlation_kernel_pin_would_refire(tmp_path):
+    """Stripping dtype=jnp.float32 from the Pallas cost-volume sum
+    refires GC805."""
+    src_path = os.path.join(
+        REPO, "video_features_tpu", "ops", "pallas", "correlation_kernel.py"
+    )
+    with open(src_path, encoding="utf-8") as fh:
+        src = fh.read()
+    pinned = "jnp.sum(f1 * f2, axis=0, dtype=jnp.float32)"
+    assert pinned in src
+    stripped = src.replace(pinned, "jnp.sum(f1 * f2, axis=0)")
+    p = tmp_path / "kernel.py"
+    p.write_text(PK + stripped)
+    fs = [f for f in run_checks([str(p)]) if f.rule.id == "GC805"]
+    assert any("accumulates in the input dtype" in f.message for f in fs)
+    control = tmp_path / "kernel_ok.py"
+    control.write_text(PK + src)
+    assert [f for f in run_checks([str(control)]) if f.rule.id == "GC805"] == []
+
+
+def test_i3d_island_annotations_would_refire(tmp_path):
+    """Deleting the fp32-island declarations from the I3D host parity
+    paths refires GC803 for each annotated cast."""
+    src_path = os.path.join(
+        REPO, "video_features_tpu", "models", "i3d", "extract_i3d.py"
+    )
+    with open(src_path, encoding="utf-8") as fh:
+        src = fh.read()
+    assert src.count("fp32-island") == 2
+    stripped = "\n".join(
+        ln for ln in src.splitlines() if "fp32-island" not in ln
+    )
+    p = tmp_path / "extract_i3d.py"
+    p.write_text(HOT + stripped)
+    fs = [f for f in run_checks([str(p)]) if f.rule.id == "GC803"]
+    assert len(fs) >= 2
+    control = tmp_path / "extract_i3d_ok.py"
+    control.write_text(HOT + src)
+    assert [f for f in run_checks([str(control)]) if f.rule.id == "GC803"] == []
+
+
+def test_cli_sarif_carries_gc80x_fix_hint(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n\n"
+        "# graftcheck: bf16-entry — fixture\n"
+        "def core(q):\n"
+        "    return jax.nn.softmax(q, axis=-1)\n"
+    )
+    r = _cli("--sarif", "--rule", "GC802", str(bad))
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "GC802"
+    assert "preferred_element_type" in res["message"]["text"]
 
 
 def test_repo_is_clean():
@@ -1669,7 +2189,8 @@ def test_rule_catalogue_complete():
                    "GC301", "GC311", "GC312", "GC313", "GC401",
                    "GC501", "GC502", "GC503", "GC504", "GC505",
                    "GC601", "GC602", "GC603",
-                   "GC701", "GC702", "GC703"]
+                   "GC701", "GC702", "GC703",
+                   "GC801", "GC802", "GC803", "GC804", "GC805"]
 
 
 def _cli(*args, cwd=REPO):

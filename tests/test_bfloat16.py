@@ -2,17 +2,17 @@
 
 bf16 runs the residual stream / conv stacks and every MXU matmul in
 bfloat16 while LayerNorm statistics, attention softmax, BatchNorm fold
-math, pools, and the final feature/logit heads stay fp32
-(VERDICT r1 #4). Expected drift at full model width, measured on random
-weights + random inputs (documented in PARITY.md):
+math, pools, flow refinement carries and the final feature/logit heads
+stay fp32 (VERDICT r1 #4, r4 for the flow nets).
 
-- CLIP ViT-B/32: ~1e-2 relative L2 on the 512-d embedding
-- ResNet-50:     ~1e-2 relative L2 on the 2048-d features
-- R(2+1)D / I3D: same order (conv stacks, fp32 heads)
-
-The flow nets (RAFT/PWC) and VGGish intentionally ignore --dtype: flow
-refinement is iterative (drift compounds over 20 GRU steps / 5 decoder
-levels) and VGGish is too small to matter.
+Drift ceilings are NOT inlined here: every bound lives in
+``analysis/parity_budget.json`` — the committed (family, dtype) table
+graftcheck GC804 cross-checks against ``config.LOW_PRECISION_MODEL_
+FAMILIES`` — and is asserted through
+``analysis.parity.assert_drift_within``. Deleting a family's budget
+entry makes its assertion here raise KeyError (the would-refire pin);
+regenerate measured drift with ``python -m video_features_tpu.analysis
+--update-budgets --scenario parity_<family>``.
 """
 
 import numpy as np
@@ -20,13 +20,8 @@ import pytest
 
 import jax.numpy as jnp
 
+from video_features_tpu.analysis.parity import assert_drift_within, rel_drift
 from video_features_tpu.config import ExtractionConfig
-from video_features_tpu.models.common.weights import cast_floats_for_compute
-
-
-def _rel(a, b):
-    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
-    return np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
 
 
 @pytest.mark.quick
@@ -36,6 +31,7 @@ def test_clip_bf16_drift_bounded():
         VisionTransformer,
         init_params,
     )
+    from video_features_tpu.models.common.weights import cast_floats_for_compute
 
     params = init_params(CLIP_VIT_B32)
     x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32))
@@ -43,10 +39,11 @@ def test_clip_bf16_drift_bounded():
     p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("proj",))
     out = VisionTransformer(CLIP_VIT_B32, dtype=jnp.bfloat16).apply({"params": p16}, x)
     assert np.asarray(out).dtype == np.float32  # fp32 output contract
-    assert _rel(out, ref) < 0.03
+    assert_drift_within("clip", "bfloat16", "model", out, ref)
 
 
 def test_resnet_bf16_drift_bounded():
+    from video_features_tpu.models.common.weights import cast_floats_for_compute
     from video_features_tpu.models.resnet.model import build, init_params
 
     params = init_params("resnet50")
@@ -54,10 +51,11 @@ def test_resnet_bf16_drift_bounded():
     ref, _ = build("resnet50").apply({"params": params}, x)
     p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("fc",))
     out, _ = build("resnet50", dtype=jnp.bfloat16).apply({"params": p16}, x)
-    assert _rel(out, ref) < 0.03
+    assert_drift_within("resnet", "bfloat16", "model", out, ref)
 
 
 def test_r21d_bf16_drift_bounded():
+    from video_features_tpu.models.common.weights import cast_floats_for_compute
     from video_features_tpu.models.r21d.model import build, init_params
 
     params = init_params()
@@ -65,10 +63,11 @@ def test_r21d_bf16_drift_bounded():
     ref, _ = build().apply({"params": params}, x)
     p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("fc",))
     out, _ = build(dtype=jnp.bfloat16).apply({"params": p16}, x)
-    assert _rel(out, ref) < 0.03
+    assert_drift_within("r21d", "bfloat16", "model", out, ref)
 
 
 def test_i3d_bf16_drift_bounded():
+    from video_features_tpu.models.common.weights import cast_floats_for_compute
     from video_features_tpu.models.i3d.model import build, init_params
 
     params = init_params("rgb")
@@ -78,7 +77,7 @@ def test_i3d_bf16_drift_bounded():
     ref, _ = build().apply({"params": params}, x)
     p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("conv3d_0c_1x1",))
     out, _ = build(dtype=jnp.bfloat16).apply({"params": p16}, x)
-    assert _rel(out, ref) < 0.03
+    assert_drift_within("i3d", "bfloat16", "model", out, ref)
 
 
 def test_dtype_flag_reaches_extractor(sample_video, tmp_path):
@@ -103,12 +102,14 @@ def test_dtype_flag_reaches_extractor(sample_video, tmp_path):
     f32 = run("float32")
     bf16 = run("bfloat16")
     assert bf16.dtype == np.float32 and bf16.shape == f32.shape
-    assert 0 < _rel(bf16, f32) < 0.03  # different numerics, same features
+    # different numerics, same features: a zero drift would mean the
+    # bf16 graph never ran
+    assert assert_drift_within("clip", "bfloat16", "e2e", bf16, f32) > 0
 
 
 def test_i3d_raft_bf16_flow_stream(sample_video, tmp_path):
     """--dtype bfloat16 on the north-star config (i3d + raft flow): the
-    flow stream now runs RAFT's mixed-precision graph (r4) feeding a bf16
+    flow stream runs RAFT's mixed-precision graph (r4) feeding a bf16
     I3D through the fp32-pinned flow_to_uint8 quantizer. Features must
     stay fp32 and land near the fp32 run — through BOTH bf16 nets AND the
     one-level quantizer flips the raft drift budget allows."""
@@ -131,4 +132,61 @@ def test_i3d_raft_bf16_flow_stream(sample_video, tmp_path):
     f32 = run("float32")
     bf16 = run("bfloat16")
     assert bf16.dtype == np.float32 and bf16.shape == f32.shape
-    assert 0 < _rel(bf16, f32) < 0.05
+    assert assert_drift_within("i3d", "bfloat16", "e2e_flow", bf16, f32) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("ft", ["raft", "pwc"])
+def test_flow_bf16_e2e_admitted(ft, sample_video, tmp_path):
+    """--dtype bfloat16 standalone flow extraction (the PR-20 admission):
+    feature_type=raft/pwc now passes sanity_check under bf16 and the
+    extracted flow stays within the committed e2e parity budget."""
+    from video_features_tpu.extract.registry import build_extractor
+
+    def run(dtype):
+        cfg = ExtractionConfig(
+            allow_random_init=True,
+            feature_type=ft,
+            video_paths=[sample_video],
+            batch_size=4,
+            dtype=dtype,
+            tmp_path=str(tmp_path / f"tmp_{dtype}"),
+            output_path=str(tmp_path / f"out_{dtype}"),
+            cpu=True,
+        )
+        ex = build_extractor(cfg, external_call=True)
+        ex.progress.disable = True
+        return ex([0])[0][ft]
+
+    f32 = run("float32")
+    bf16 = run("bfloat16")
+    assert bf16.dtype == np.float32 and bf16.shape == f32.shape
+    assert assert_drift_within(ft, "bfloat16", "e2e", bf16, f32) > 0
+
+
+def test_unadmitted_dtype_rejected(tmp_path):
+    """sanity_check enforces the GC804 admission table: a family outside
+    LOW_PRECISION_MODEL_FAMILIES cannot take --dtype bfloat16."""
+    from video_features_tpu.config import sanity_check
+
+    with pytest.raises(ValueError, match="not admitted"):
+        sanity_check(
+            ExtractionConfig(
+                feature_type="vggish",
+                dtype="bfloat16",
+                tmp_path=str(tmp_path / "tmp"),
+                output_path=str(tmp_path / "out"),
+            )
+        )
+
+
+def test_parity_budget_would_refire():
+    """Would-refire pin (GC804 satellite): deleting a model's budget
+    entry must fail loudly — the helper raises KeyError naming the
+    regeneration command, so the e2e assertions above cannot silently
+    pass without a committed ceiling."""
+    with pytest.raises(KeyError, match="update-budgets"):
+        assert_drift_within("clip", "bfloat16", "nonexistent-kind", [1.0], [1.0])
+    # and the metric itself: identical inputs -> 0, scaled -> relative
+    assert rel_drift([1.0, 0.0], [1.0, 0.0]) == 0.0
+    assert abs(rel_drift([1.01, 0.0], [1.0, 0.0]) - 0.01) < 1e-12

@@ -25,6 +25,14 @@ depend on into tier-1 test failures instead of review-time folklore:
   name maps to a curated exposition family (and every family has a
   producer), fault stages match ``fire()`` sites both directions, and
   config.py's flags / dataclass fields / sanity checks stay in sync.
+- GC80x numerics & dtype-flow contracts (:mod:`.numerics`) — no f64
+  promotion leaks into jit-reachable code, matmuls and sensitive
+  reductions under bf16-polymorphic entries pin their accumulation
+  dtype, host-side float32 casts on frame payloads are declared
+  islands, every admitted (family, dtype) pair carries a committed
+  drift ceiling in ``analysis/parity_budget.json`` plus an e2e parity
+  assertion, and Pallas kernels keep accumulator/grid/interpret
+  hygiene.
 
 Run ``python -m video_features_tpu.analysis`` (CLI) or
 ``pytest -m analysis`` (tier-1). Waive individual findings with inline
@@ -45,15 +53,25 @@ from video_features_tpu.analysis.core import (
     collect_sources,
     run_checks,
 )
+from video_features_tpu.analysis.parity import (
+    assert_drift_within,
+    load_parity_budget,
+    max_rel_drift,
+    rel_drift,
+)
 
 __all__ = [
     "CompileCounter",
     "Finding",
     "Rule",
     "all_rules",
+    "assert_drift_within",
     "assert_within_budget",
     "check_counts",
     "collect_sources",
     "load_budget",
+    "load_parity_budget",
+    "max_rel_drift",
+    "rel_drift",
     "run_checks",
 ]

@@ -80,7 +80,9 @@ def _counted(run: Callable[[], object]) -> Dict[str, int]:
     return dict(cc.counts)
 
 
-def _clip_run(tmp: str, video_batch: int) -> Dict[str, int]:
+def _clip_run(
+    tmp: str, video_batch: int, dtype: str = "float32"
+) -> Dict[str, int]:
     from video_features_tpu.config import ExtractionConfig, sanity_check
     from video_features_tpu.models.clip.extract_clip import ExtractCLIP
 
@@ -91,6 +93,7 @@ def _clip_run(tmp: str, video_batch: int) -> Dict[str, int]:
             extract_method="uni_4",
             preprocess="device",
             video_batch=video_batch,
+            dtype=dtype,
             video_paths=_mixed_videos(tmp),
             tmp_path=os.path.join(tmp, "tmp"),
             output_path=os.path.join(tmp, "out"),
@@ -108,7 +111,9 @@ def _mesh_device():
     return make_mesh(jax.devices(), model=1)
 
 
-def _flow_run(tmp: str, ft: str, mesh: bool = False) -> Dict[str, int]:
+def _flow_run(
+    tmp: str, ft: str, mesh: bool = False, dtype: str = "float32"
+) -> Dict[str, int]:
     from video_features_tpu.config import ExtractionConfig, sanity_check
 
     if ft == "raft":
@@ -127,6 +132,7 @@ def _flow_run(tmp: str, ft: str, mesh: bool = False) -> Dict[str, int]:
             batch_size=4,
             preprocess="device",
             sharding="mesh" if mesh else "queue",
+            dtype=dtype,
             tmp_path=os.path.join(tmp, "tmp"),
             output_path=os.path.join(tmp, "out"),
             cpu=True,
@@ -216,6 +222,37 @@ SCENARIOS: Dict[str, Scenario] = {
         tracked=("rgb_fn", "flow_fn"),
         runner=lambda tmp: _i3d_run(tmp),
     ),
+    # --- dtype axis: the bf16 variants of the single-device scenarios.
+    # The invariant is the same bucket sharing as fp32 — switching dtype
+    # swaps which executable compiles, it must not ADD executables, so
+    # the bf16 ceilings match their fp32 twins (tests/test_compile_budget
+    # pins the equality).
+    "clip_device_mixed_bf16": Scenario(
+        description=(
+            "clip_device_mixed with --dtype bfloat16: the mixed-precision "
+            "encode_raw still compiles once per spatial bucket — bf16 "
+            "swaps the executable, it must not multiply them."
+        ),
+        tracked=("encode_raw",),
+        runner=lambda tmp: _clip_run(tmp, video_batch=1, dtype="bfloat16"),
+    ),
+    "raft_device_tiny_bf16": Scenario(
+        description=(
+            "raft_device_tiny with --dtype bfloat16: RAFT's mixed-precision "
+            "graph (convs bf16, GRU carry/softmax/corr pyramid fp32) keeps "
+            "the one-executable-per-padder-grid contract."
+        ),
+        tracked=("forward_raw",),
+        runner=lambda tmp: _flow_run(tmp, "raft", dtype="bfloat16"),
+    ),
+    "pwc_device_tiny_bf16": Scenario(
+        description=(
+            "pwc_device_tiny with --dtype bfloat16: the bf16 pyramid "
+            "compiles one fused forward_raw, same as fp32."
+        ),
+        tracked=("forward_raw",),
+        runner=lambda tmp: _flow_run(tmp, "pwc", dtype="bfloat16"),
+    ),
     "raft_mesh_device_tiny": Scenario(
         description=(
             "ExtractRAFT --sharding mesh --preprocess device on the tiny "
@@ -260,15 +297,29 @@ def measure(name: str) -> Dict[str, int]:
 
 
 def update_budgets(names: Optional[Sequence[str]] = None) -> int:
-    """Re-measure ``names`` (default: every scenario) and rewrite
+    """Re-measure ``names`` (default: every compile scenario) and rewrite
     ``compile_budget.json`` with the observed counts as the new ceilings.
-    Returns a process exit code (0 ok, 2 on unknown scenario)."""
+    ``parity_*`` names route to the numerics twin
+    (:func:`analysis.parity.update_parity_budgets`), which rewrites the
+    ``measured`` drift column of ``parity_budget.json`` instead — parity
+    scenarios only run when explicitly named, they are not part of the
+    default sweep. Returns a process exit code (0 ok, 2 on unknown
+    scenario)."""
     chosen = list(names) if names else sorted(SCENARIOS)
+    parity = [n for n in chosen if n.startswith("parity_")]
+    chosen = [n for n in chosen if not n.startswith("parity_")]
+    if parity:
+        from video_features_tpu.analysis.parity import update_parity_budgets
+
+        rc = update_parity_budgets(parity)
+        if rc or not chosen:
+            return rc
     unknown = [n for n in chosen if n not in SCENARIOS]
     if unknown:
         print(
             f"graftcheck: unknown scenario(s): {', '.join(unknown)} "
-            f"(have: {', '.join(sorted(SCENARIOS))})",
+            f"(have: {', '.join(sorted(SCENARIOS))} + "
+            "parity_<family> drift scenarios)",
             file=sys.stderr,
         )
         return 2

@@ -14,12 +14,20 @@ GC10x family). ``git grep 'graftcheck:'`` audits every waiver in one
 sweep — that greppability is the reason waivers are inline comments and
 not a config file.
 
-Two file-level markers ride the same comment syntax:
+File-level markers ride the same comment syntax (they declare facts,
+they never waive findings — no marker token prefix-matches a rule name):
 
 - ``# graftcheck: hot-module`` — opt a file into the host-sync lint's
   hot set beyond the built-in path patterns (used by test fixtures).
 - ``# graftcheck: thread-root`` — declare a file a thread-spawning root
   for the thread-safety reachability walk.
+- ``# graftcheck: pallas-kernel`` — opt a file into the GC805 Pallas
+  hygiene sweep beyond the built-in ``ops/pallas/`` path.
+- ``# graftcheck: bf16-entry`` — declare every def in the file (or, on
+  a def line, that one def) a bf16-polymorphic entry for GC802.
+
+The GC80x numerics family additionally reads the line/def-scoped
+``# graftcheck: fp32-island — <why>`` declaration (docs/analysis.md).
 """
 
 from __future__ import annotations
@@ -161,7 +169,12 @@ class SourceFile:
                 tokens_ = {t.strip().lower() for t in spec.split(",") if t.strip()}
                 if not tokens_:
                     continue
-                self.markers |= {t for t in tokens_ if t in ("hot-module", "thread-root")}
+                self.markers |= {
+                    t
+                    for t in tokens_
+                    if t in ("hot-module", "thread-root", "pallas-kernel",
+                             "bf16-entry")
+                }
                 line = tok.start[0]
                 self.waivers.setdefault(line, set()).update(tokens_)
                 # a comment-only line waives the statement it precedes:
@@ -387,6 +400,7 @@ def run_checks(
         durability,
         hostsync,
         jit_hygiene,
+        numerics,
         obs_contract,
         sharding_contract,
         thread_safety,
@@ -409,6 +423,7 @@ def run_checks(
     findings.extend(sharding_contract.check(sources, graph))
     findings.extend(durability.check(sources, graph, project))
     findings.extend(obs_contract.check(sources))
+    findings.extend(numerics.check(sources, graph, project))
 
     kept = []
     for f in findings:
@@ -428,6 +443,7 @@ def all_rules() -> List[Rule]:
         durability,
         hostsync,
         jit_hygiene,
+        numerics,
         obs_contract,
         sharding_contract,
         thread_safety,
@@ -443,4 +459,5 @@ def all_rules() -> List[Rule]:
         *sharding_contract.RULES.values(),
         *durability.RULES.values(),
         *obs_contract.RULES.values(),
+        *numerics.RULES.values(),
     ]

@@ -1,0 +1,1290 @@
+"""GC80x — numerics & dtype-flow contracts for the low-precision path.
+
+The ROADMAP's remaining "saturate the chip" lever is dropping precision
+in the FastCLIP spirit — and until now every piece of that story was
+convention: the fp32 islands inside the bf16 model graphs (LayerNorm
+statistics, softmax, GRU carries), the ``preferred_element_type`` pins
+on the MXU matmuls, the uint8-to-the-wire H2D contract, the flash
+kernel's fp32 VMEM accumulators. Nothing stopped a refactor from
+silently dropping a pin; the drift only shows up as a slightly worse
+feature vector, far from any assert. GC80x makes the numerics contract
+machine-checked, riding the PR-5 call graph + taint fixpoint:
+
+- **GC801 implicit-promotion** — float64 constructs (``np.float64``,
+  ``astype(float)``, ``dtype="float64"``, f64-default numpy creators)
+  inside jit-reachable code. f64 doubles HBM pressure and is
+  unsupported on TPU without x64. Interprocedural: a helper whose
+  *return value* carries an f64 construct is flagged at its jit-side
+  caller, with the construct site in the ``via:`` trace.
+- **GC802 accum-dtype** — matmul-family ops (dot/einsum/conv) and
+  numerically-sensitive reductions (softmax, mean/var, exp, cumsum,
+  norm) reachable under a *bf16-polymorphic entry* (a def with a
+  ``dtype`` parameter, a method of a class with a ``dtype`` field, or a
+  ``# graftcheck: bf16-entry`` declaration) must pin accumulation:
+  ``preferred_element_type=jnp.float32`` / ``dtype=jnp.float32`` /
+  ``precision=HIGHEST``, a visible ``.astype(jnp.float32)`` on an
+  operand, or an explicit ``# graftcheck: fp32-island — <why>``
+  declaration on the def or the line. Stripping a pin fails tier-1.
+- **GC803 cast-discipline** — host-side ``astype(float32)`` on frame
+  payloads in hot modules: a float32 frame ships 4x the bytes of the
+  uint8 wire format PRs 1/14 standardized. Flagged with the device-side
+  fix; host-only parity paths carry an ``fp32-island`` declaration.
+- **GC804 parity-pin-coverage** — config.py's
+  ``LOW_PRECISION_MODEL_FAMILIES`` admission table and the committed
+  ``analysis/parity_budget.json`` max-drift table must cover each other
+  exactly, and every admitted (family, dtype) pair must be asserted by
+  an e2e parity test (``assert_drift_within``/``max_rel_drift`` in
+  tests/). ``--update-budgets --scenario parity_<family>`` regenerates
+  measured drift; ceilings are the committed contract.
+- **GC805 pallas-hygiene** — over ``ops/pallas/`` (or files marked
+  ``# graftcheck: pallas-kernel``): cross-grid-step accumulation must
+  land in float32 VMEM scratch (staging tiles that are only read are
+  exempt), kernel-body dots/reductions pin their accumulation dtype,
+  ``//``-built grids need a divisibility guard (``cdiv`` grids need a
+  pad or guard), and every kernel wrapper exposes ``interpret=`` and
+  has an interpret-mode parity test under tests/.
+
+Three declaration tokens ride the ``# graftcheck:`` comment syntax but
+are NOT waivers — none of them prefix-matches a rule name, so the
+zero-waiver policy is preserved; they are typed facts the checkers read:
+
+- ``fp32-island — <why>`` (def or line): the values flowing through
+  here are already fp32 by an upstream contract the AST cannot see
+  (e.g. RAFT's GRU carry pins); the reason clause is mandatory prose.
+- ``bf16-entry`` (def or file): this code runs under bf16 inputs even
+  though no ``dtype`` parameter/field names it (e.g. the attention
+  cores that receive whatever dtype the caller's activations carry) —
+  it WIDENS GC802 coverage, never narrows it.
+- ``pallas-kernel`` (file): opt a file into the GC805 sweep beyond the
+  built-in ``ops/pallas/`` path (test-fixture contract).
+
+Resolution is exact-only (taint.py semantics) for both the jit
+reachability walk (GC801) and the bf16 entry closure (GC802); findings
+carry the reachability chain in ``trace`` (``--explain GC80``).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from video_features_tpu.analysis.callgraph import CallGraph, FunctionInfo
+from video_features_tpu.analysis.concurrency import _exact_callees, _own_nodes
+from video_features_tpu.analysis.core import (
+    Finding,
+    Rule,
+    SourceFile,
+    import_aliases,
+    is_jax_jit,
+    jit_decoration,
+    package_root,
+    param_names,
+    resolve_dotted,
+)
+from video_features_tpu.analysis.taint import ProjectTaint, _target_names
+
+RULES = {
+    "GC801": Rule(
+        "GC801", "implicit-promotion",
+        "float64 construct inside jit-reachable code promotes traced values",
+    ),
+    "GC802": Rule(
+        "GC802", "accum-dtype",
+        "matmul/reduction under a bf16-polymorphic entry lacks an fp32 "
+        "accumulation pin",
+    ),
+    "GC803": Rule(
+        "GC803", "cast-discipline",
+        "host-side float32 cast on a frame payload quadruples H2D bytes",
+    ),
+    "GC804": Rule(
+        "GC804", "parity-pin-coverage",
+        "config-admitted (family, dtype) lacks a committed parity budget "
+        "or its e2e assertion",
+    ),
+    "GC805": Rule(
+        "GC805", "pallas-hygiene",
+        "Pallas kernel accumulator/grid/parity-test hygiene violation",
+    ),
+}
+
+ISLAND_TOKEN = "fp32-island"
+BF16_ENTRY_TOKEN = "bf16-entry"
+PALLAS_MARKER = "pallas-kernel"
+
+_HINT_801 = (
+    "stay in float32/bfloat16 (jnp.float32 literals, dtype=np.float32): "
+    "f64 doubles HBM and needs x64 mode the TPU path never enables"
+)
+_HINT_802 = (
+    "pin the accumulation: preferred_element_type=jnp.float32 / "
+    "dtype=jnp.float32 / precision='highest', cast an operand "
+    ".astype(jnp.float32), or declare `# graftcheck: fp32-island — <why>` "
+    "when an upstream contract already keeps these values fp32"
+)
+_HINT_803 = (
+    "ship uint8 to the wire and cast on device inside the jitted consumer "
+    "(--preprocess device contract, docs/tpu.md 'Precision contract'); a "
+    "host-only parity path declares `# graftcheck: fp32-island — <why>`"
+)
+_HINT_804 = (
+    "commit the drift ceiling in analysis/parity_budget.json (regenerate "
+    "measured drift via --update-budgets --scenario parity_<family>) and "
+    "assert it end-to-end with analysis.parity.assert_drift_within in tests/"
+)
+_HINT_805 = (
+    "accumulate in float32 VMEM scratch (store once at the end), pin kernel "
+    "dots/reductions with preferred_element_type/dtype=jnp.float32, guard "
+    "//-grids with a `% -> raise`, and keep an interpret=True parity test "
+    "per kernel wrapper"
+)
+
+
+# --- shared dtype / token predicates ----------------------------------------
+
+_F64_NAMES = frozenset(
+    {
+        "float",
+        "builtins.float",
+        "numpy.float64",
+        "numpy.double",
+        "numpy.float_",
+        "jax.numpy.float64",
+        "jax.numpy.double",
+    }
+)
+_F64_DEFAULT_CREATORS = frozenset(
+    {
+        "numpy.zeros",
+        "numpy.ones",
+        "numpy.empty",
+        "numpy.full",
+        "numpy.linspace",
+        "numpy.eye",
+        "numpy.identity",
+    }
+)
+_MATMUL = frozenset(
+    {
+        "jax.numpy.dot",
+        "jax.numpy.vdot",
+        "jax.numpy.inner",
+        "jax.numpy.matmul",
+        "jax.numpy.tensordot",
+        "jax.numpy.einsum",
+        "jax.lax.dot",
+        "jax.lax.dot_general",
+        "jax.lax.conv",
+        "jax.lax.conv_general_dilated",
+        "jax.experimental.pallas.dot",
+    }
+)
+_SENSITIVE = frozenset(
+    {
+        "jax.nn.softmax",
+        "jax.nn.log_softmax",
+        "jax.nn.logsumexp",
+        "jax.scipy.special.logsumexp",
+        "jax.numpy.mean",
+        "jax.numpy.var",
+        "jax.numpy.std",
+        "jax.numpy.cumsum",
+        "jax.numpy.exp",
+        "jax.numpy.linalg.norm",
+    }
+)
+_SENSITIVE_METHODS = frozenset({"mean", "var", "std", "cumsum"})
+_KERNEL_REDUCTIONS = frozenset(
+    {"jax.numpy.sum", "jax.numpy.mean", "jax.numpy.cumsum", "jax.numpy.prod"}
+)
+_KERNEL_REDUCTION_METHODS = frozenset({"sum", "mean", "cumsum", "prod"})
+
+
+def _is_f64_dtype(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in ("float64", "double", "f8", "<f8", ">f8")
+    rd = resolve_dotted(node, aliases)
+    if rd in _F64_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        rd = resolve_dotted(node.func, aliases)
+        if rd in ("numpy.dtype", "jax.numpy.dtype") and node.args:
+            return _is_f64_dtype(node.args[0], aliases)
+    return False
+
+
+def _is_f32_dtype(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in ("float32", "f4", "<f4", ">f4")
+    rd = resolve_dotted(node, aliases)
+    return rd is not None and (rd == "float32" or rd.endswith(".float32"))
+
+
+def _is_highest(
+    node: ast.AST, aliases: Dict[str, str], highs: Set[str] = frozenset()
+) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.lower() == "highest"
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return bool(node.elts) and all(
+            _is_highest(e, aliases, highs) for e in node.elts
+        )
+    if isinstance(node, ast.Name) and node.id in highs:
+        return True
+    rd = resolve_dotted(node, aliases)
+    return rd is not None and rd.endswith("HIGHEST")
+
+
+def _call_has_pin(
+    call: ast.Call, aliases: Dict[str, str], highs: Set[str] = frozenset()
+) -> bool:
+    """An fp32 accumulation pin attached AT the call site."""
+    for kw in call.keywords:
+        if kw.arg in ("preferred_element_type", "dtype") and _is_f32_dtype(
+            kw.value, aliases
+        ):
+            return True
+        if kw.arg == "precision" and _is_highest(kw.value, aliases, highs):
+            return True
+    return False
+
+
+def _highest_names(fn: ast.FunctionDef, aliases: Dict[str, str]) -> Set[str]:
+    """Local names assigned from a HIGHEST precision value
+    (``hp = jax.lax.Precision.HIGHEST``)."""
+    out: Set[str] = set()
+    for st in _own_nodes(fn):
+        if isinstance(st, ast.Assign) and _is_highest(st.value, aliases):
+            for tgt in st.targets:
+                out.update(_target_names(tgt))
+    return out
+
+
+def _def_tokens(src: SourceFile, fn: ast.FunctionDef) -> Set[str]:
+    """graftcheck tokens attached to a def: on the def/decorator lines or
+    (via core's carry rule) a standalone comment directly above them."""
+    lines = set(range(fn.lineno, fn.body[0].lineno))
+    lines.add(fn.lineno)
+    for dec in fn.decorator_list:
+        lines.add(dec.lineno)
+    out: Set[str] = set()
+    for ln in lines:
+        out |= src.waivers.get(ln, set())
+    return out
+
+
+def _islanded(src: SourceFile, info: Optional[FunctionInfo], line: int) -> bool:
+    if ISLAND_TOKEN in src.waivers.get(line, ()):
+        return True
+    return info is not None and ISLAND_TOKEN in _def_tokens(src, info.node)
+
+
+# --- call-graph plumbing ----------------------------------------------------
+
+def _module_calls(src: SourceFile) -> List[ast.Call]:
+    """Call nodes in the module body, pruning function bodies (those are
+    covered per-FunctionInfo via ``_own_nodes``)."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = [src.tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+    return out
+
+
+class _Ctx:
+    """Per-sweep cache: exact call edges + per-function aliases."""
+
+    def __init__(self, sources: Sequence[SourceFile], graph: CallGraph) -> None:
+        self.sources = list(sources)
+        self.graph = graph
+        self.aliases = {s.rel: import_aliases(s.tree) for s in sources}
+        # key -> [(Call node, [callee keys])] over _own_nodes, exact-only
+        self.succs: Dict[str, List[Tuple[ast.Call, List[str]]]] = {}
+        for key, info in graph.functions.items():
+            edges: List[Tuple[ast.Call, List[str]]] = []
+            for node in _own_nodes(info.node):
+                if isinstance(node, ast.Call):
+                    cks = _exact_callees(node.func, info.src, info, graph)
+                    if cks:
+                        edges.append((node, cks))
+            self.succs[key] = edges
+
+    def reach(self, roots: Sequence[str]) -> Dict[str, Tuple[str, ...]]:
+        """key -> root-first chain of keys, closed over exact calls."""
+        chains: Dict[str, Tuple[str, ...]] = {}
+        frontier: List[str] = []
+        for r in sorted(set(roots)):
+            chains[r] = (r,)
+            frontier.append(r)
+        while frontier:
+            nxt: List[str] = []
+            for key in frontier:
+                for _, cks in self.succs.get(key, ()):
+                    for ck in cks:
+                        if ck not in chains:
+                            chains[ck] = chains[key] + (ck,)
+                            nxt.append(ck)
+            frontier = nxt
+        return chains
+
+    def chain_trace(self, chain: Tuple[str, ...], head: str) -> List[str]:
+        steps: List[str] = []
+        prev: Optional[FunctionInfo] = None
+        for i, k in enumerate(chain):
+            info = self.graph.functions[k]
+            if i == 0:
+                steps.append(
+                    f"{info.src.path}:{info.node.lineno}: {head} {info.name!r}"
+                )
+            else:
+                steps.append(
+                    f"{info.src.path}:{info.node.lineno}: {info.name!r} "
+                    f"reachable from {prev.name!r}"
+                )
+            prev = info
+        return steps
+
+
+# --- GC801 implicit promotion ----------------------------------------------
+
+def _f64_sites(
+    info: FunctionInfo, aliases: Dict[str, str]
+) -> List[Tuple[ast.Call, str]]:
+    out: List[Tuple[ast.Call, str]] = []
+    for node in _own_nodes(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _is_f64_dtype(node.args[0], aliases)
+        ):
+            out.append((node, "astype(float64) widens the value"))
+            continue
+        rd = resolve_dotted(node.func, aliases)
+        if rd in ("numpy.float64", "numpy.double", "jax.numpy.float64"):
+            out.append((node, f"{rd}(...) builds a float64 scalar"))
+            continue
+        dtype_kw = next((kw for kw in node.keywords if kw.arg == "dtype"), None)
+        if dtype_kw is not None:
+            if _is_f64_dtype(dtype_kw.value, aliases):
+                out.append((node, "dtype= selects float64"))
+            continue
+        if rd in _F64_DEFAULT_CREATORS:
+            out.append((node, f"{rd}() defaults to float64 (no dtype=)"))
+    return out
+
+
+def _jit_roots(ctx: _Ctx) -> Set[str]:
+    roots: Set[str] = set()
+    graph = ctx.graph
+    for key, info in graph.functions.items():
+        if jit_decoration(info.node, ctx.aliases[info.src.rel]):
+            roots.add(key)
+    # jax.jit(fn) wrap sites, module-level and inside functions
+    for src in ctx.sources:
+        aliases = ctx.aliases[src.rel]
+
+        def wrapped(call: ast.Call, caller: Optional[FunctionInfo]) -> None:
+            if is_jax_jit(call.func, aliases) and call.args:
+                keys, _ = graph.resolve_call(call.args[0], src, caller)
+                roots.update(keys)
+
+        for call in _module_calls(src):
+            wrapped(call, None)
+        for key, info in graph.functions.items():
+            if info.src is not src:
+                continue
+            for node in _own_nodes(info.node):
+                if isinstance(node, ast.Call):
+                    wrapped(node, info)
+    return roots
+
+
+def _check_promotion(ctx: _Ctx) -> List[Finding]:
+    graph = ctx.graph
+    roots = _jit_roots(ctx)
+    chains = ctx.reach(sorted(roots))
+    # f64 constructs sitting in a function's RETURN path, for every
+    # function in the project (the interprocedural leg needs them even
+    # when the helper itself would not be swept)
+    returning: Dict[str, List[Tuple[ast.Call, str]]] = {}
+    for key, info in graph.functions.items():
+        aliases = ctx.aliases[info.src.rel]
+        in_return: Set[int] = set()
+        for node in _own_nodes(info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    in_return.add(id(sub))
+        hits = [
+            (n, d) for n, d in _f64_sites(info, aliases) if id(n) in in_return
+        ]
+        if hits:
+            returning[key] = hits
+
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int, int, str]] = set()
+
+    def emit(src, node, msg, trace):
+        k = (src.path, node.lineno, node.col_offset, msg)
+        if k in seen:
+            return
+        seen.add(k)
+        out.append(
+            Finding(src.path, node.lineno, node.col_offset, RULES["GC801"],
+                    msg, _HINT_801, trace)
+        )
+
+    for key, chain in chains.items():
+        info = graph.functions[key]
+        src = info.src
+        aliases = ctx.aliases[src.rel]
+        ret_ids = {id(n) for n, _ in returning.get(key, ())}
+        for node, desc in _f64_sites(info, aliases):
+            if _islanded(src, info, node.lineno):
+                continue
+            if key not in roots and id(node) in ret_ids:
+                # reported at the jit-side caller below, where the f64
+                # value actually meets traced code
+                continue
+            emit(
+                src, node,
+                f"{desc} inside jit-reachable {info.name!r}",
+                ctx.chain_trace(chain, "jitted entry"),
+            )
+        # interprocedural: calls whose exact callee RETURNS an f64 value
+        for call, cks in ctx.succs.get(key, ()):
+            for ck in cks:
+                hits = returning.get(ck)
+                if not hits or (ck in roots):
+                    continue
+                if _islanded(src, info, call.lineno):
+                    continue
+                callee = graph.functions[ck]
+                for n, desc in hits:
+                    emit(
+                        src, call,
+                        f"call to {callee.name!r} returns float64 into "
+                        f"jit-reachable {info.name!r}",
+                        [f"{callee.src.path}:{n.lineno}: {desc}"]
+                        + ctx.chain_trace(chain, "jitted entry"),
+                    )
+    return out
+
+
+# --- GC802 accumulation dtype ----------------------------------------------
+
+def _dtype_field_classes(src: SourceFile) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for st in node.body:
+            if (
+                isinstance(st, ast.AnnAssign)
+                and isinstance(st.target, ast.Name)
+                and st.target.id == "dtype"
+            ):
+                out.add(node.name)
+            elif isinstance(st, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "dtype" for t in st.targets
+            ):
+                out.add(node.name)
+    return out
+
+
+def _bf16_entries(ctx: _Ctx) -> Dict[str, str]:
+    """entry key -> why it is bf16-polymorphic."""
+    entries: Dict[str, str] = {}
+    dtype_classes = {s.rel: _dtype_field_classes(s) for s in ctx.sources}
+    for key, info in ctx.graph.functions.items():
+        src = info.src
+        if BF16_ENTRY_TOKEN in src.markers:
+            entries[key] = "bf16-entry file marker"
+            continue
+        if BF16_ENTRY_TOKEN in _def_tokens(src, info.node):
+            entries[key] = "bf16-entry declaration"
+            continue
+        if info.cls and info.cls in dtype_classes.get(src.rel, ()):
+            entries[key] = f"method of dtype-polymorphic class {info.cls!r}"
+            continue
+        if "dtype" in param_names(info.node):
+            entries[key] = "takes a dtype parameter"
+    return entries
+
+
+def _pinning_expr(
+    node: ast.AST,
+    aliases: Dict[str, str],
+    pinned: Set[str],
+    highs: Set[str] = frozenset(),
+) -> bool:
+    """Does evaluating ``node`` visibly produce an fp32 value?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in pinned:
+            return True
+        if isinstance(sub, ast.Attribute):
+            rd = resolve_dotted(sub, aliases)
+            if rd is not None and rd.endswith(".float32"):
+                return True
+        if isinstance(sub, ast.Call):
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "astype"
+                and sub.args
+                and _is_f32_dtype(sub.args[0], aliases)
+            ):
+                return True
+            if _call_has_pin(sub, aliases, highs):
+                return True
+    return False
+
+
+def _is_dtype_election(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """``.astype(self.dtype)`` / ``.astype(dtype)``: the expression casts
+    to the entry's polymorphic dtype on purpose."""
+    if not (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and node.args
+    ):
+        return False
+    arg = node.args[0]
+    if isinstance(arg, ast.Name) and arg.id == "dtype":
+        return True
+    return (
+        isinstance(arg, ast.Attribute)
+        and arg.attr == "dtype"
+        and isinstance(arg.value, ast.Name)
+        and arg.value.id == "self"
+    )
+
+
+def _electing_expr(
+    node: ast.AST, aliases: Dict[str, str], elected: Set[str]
+) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in elected:
+            return True
+        if _is_dtype_election(sub, aliases):
+            return True
+    return False
+
+
+def _elected_names(fn: ast.FunctionDef, aliases: Dict[str, str]) -> Set[str]:
+    """Local names visibly assigned from dtype-election expressions
+    (``x = x.astype(self.dtype)``), propagated like ``_pinned_names``."""
+    elected: Set[str] = set()
+    stmts = [
+        st
+        for st in _own_nodes(fn)
+        if isinstance(st, (ast.Assign, ast.AnnAssign)) and st.value is not None
+    ]
+    for _ in range(3):
+        changed = False
+        for st in stmts:
+            if not _electing_expr(st.value, aliases, elected):
+                continue
+            targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+            for tgt in targets:
+                for n in _target_names(tgt):
+                    if n not in elected:
+                        elected.add(n)
+                        changed = True
+        if not changed:
+            break
+    return elected
+
+
+def _pinned_names(
+    fn: ast.FunctionDef,
+    aliases: Dict[str, str],
+    seed: Optional[Set[str]] = None,
+    highs: Set[str] = frozenset(),
+) -> Set[str]:
+    """Local names visibly assigned from fp32-pinned expressions,
+    propagated through simple chains (3 passes)."""
+    pinned: Set[str] = set(seed or ())
+    stmts = [
+        st
+        for st in _own_nodes(fn)
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+    ]
+    for _ in range(3):
+        changed = False
+        for st in stmts:
+            if st.value is None:
+                continue
+            if not _pinning_expr(st.value, aliases, pinned, highs):
+                continue
+            targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+            for tgt in targets:
+                for n in _target_names(tgt):
+                    if n not in pinned:
+                        pinned.add(n)
+                        changed = True
+        if not changed:
+            break
+    return pinned
+
+
+def _operands(call: ast.Call, rd: Optional[str]) -> List[ast.AST]:
+    args = list(call.args)
+    if rd is not None and rd.endswith("einsum") and args:
+        first = args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            args = args[1:]
+    return args
+
+
+def _check_accum(ctx: _Ctx) -> List[Finding]:
+    graph = ctx.graph
+    entries = _bf16_entries(ctx)
+    chains = ctx.reach(sorted(entries))
+    out: List[Finding] = []
+    for key, chain in chains.items():
+        info = graph.functions[key]
+        src = info.src
+        if src.rel.startswith("ops/pallas/") or PALLAS_MARKER in src.markers:
+            continue  # GC805 owns kernel bodies
+        aliases = ctx.aliases[src.rel]
+        if ISLAND_TOKEN in _def_tokens(src, info.node):
+            continue
+        highs = _highest_names(info.node, aliases)
+        pinned = _pinned_names(info.node, aliases, highs=highs)
+        elected = _elected_names(info.node, aliases)
+        entry = graph.functions[chain[0]]
+        trace = ctx.chain_trace(chain, "bf16-polymorphic entry")
+
+        def emit(node, what):
+            out.append(
+                Finding(
+                    src.path, node.lineno, node.col_offset, RULES["GC802"],
+                    f"{what} under bf16-polymorphic entry {entry.name!r} "
+                    "without an fp32 accumulation pin",
+                    _HINT_802, trace,
+                )
+            )
+
+        for node in _own_nodes(info.node):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                if _islanded(src, None, node.lineno):
+                    continue
+                sides = (node.left, node.right)
+                if any(_pinning_expr(s, aliases, pinned, highs) for s in sides):
+                    continue
+                if any(_electing_expr(s, aliases, elected) for s in sides):
+                    continue  # operands cast to the entry dtype on purpose
+                emit(node, "`@` matmul")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            rd = resolve_dotted(node.func, aliases)
+            kind: Optional[str] = None
+            is_matmul = False
+            operands: List[ast.AST] = []
+            if rd in _MATMUL:
+                kind = rd.rsplit(".", 1)[-1]
+                is_matmul = True
+                operands = _operands(node, rd)
+            elif rd in _SENSITIVE:
+                kind = rd.rsplit(".", 1)[-1]
+                operands = list(node.args)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SENSITIVE_METHODS
+                and isinstance(node.func.value, ast.Name)
+            ):
+                kind = f".{node.func.attr}()"
+                operands = [node.func.value]
+            if kind is None:
+                continue
+            if _islanded(src, None, node.lineno):
+                continue
+            if _call_has_pin(node, aliases, highs):
+                continue
+            if any(_pinning_expr(a, aliases, pinned, highs) for a in operands):
+                continue
+            if is_matmul and any(
+                _electing_expr(a, aliases, elected) for a in operands
+            ):
+                # a matmul whose operands are deliberately cast to the
+                # entry's polymorphic dtype made its precision choice
+                # visibly (the MXU still accumulates f32 internally);
+                # sensitive reductions get no such pass.
+                continue
+            emit(node, kind)
+    return out
+
+
+# --- GC803 cast discipline --------------------------------------------------
+
+_CAST_SCOPE_PATTERNS = ("models/*/extract_*.py",)
+_FRAME_PIECES = frozenset(
+    {
+        "frame", "frames", "clip", "clips", "img", "imgs", "image", "images",
+        "video", "videos", "rgb", "flow", "pair", "pairs", "pixels", "stack",
+        "stacks", "crop", "crops",
+    }
+)
+_NP_WRAPPERS = frozenset(
+    {
+        "numpy.asarray", "numpy.array", "numpy.stack", "numpy.concatenate",
+        "numpy.ascontiguousarray",
+    }
+)
+
+
+def _frameish(name: str) -> bool:
+    return any(p in _FRAME_PIECES for p in name.lower().split("_"))
+
+
+def _is_host_f32(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """float32 spelled the *host* way: ``np.float32`` or a string.
+    ``jnp.float32`` implies the cast targets a device value (e.g. the
+    RAFT corr-pyramid pins) and is GC802's business, not GC803's."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in ("float32", "f4", "<f4", ">f4")
+    rd = resolve_dotted(node, aliases)
+    return rd in ("numpy.float32", "numpy.single", "float32")
+
+
+def _frameish_locals(fn: ast.FunctionDef) -> Set[str]:
+    local: Set[str] = {p for p in param_names(fn) if _frameish(p)}
+
+    def mentions(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                _frameish(sub.id) or sub.id in local
+            ):
+                return True
+            if isinstance(sub, ast.Attribute) and _frameish(sub.attr):
+                return True
+        return False
+
+    for _ in range(2):
+        changed = False
+        for node in _own_nodes(fn):
+            targets: List[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if mentions(node.iter):
+                    targets = [node.target]
+            elif isinstance(node, ast.comprehension):
+                if mentions(node.iter):
+                    targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                if node.value is not None and mentions(node.value):
+                    targets = list(node.targets)
+            for tgt in targets:
+                for n in _target_names(tgt):
+                    if n not in local:
+                        local.add(n)
+                        changed = True
+        if not changed:
+            break
+    return local
+
+
+def _check_cast_discipline(
+    ctx: _Ctx, project: ProjectTaint, jit_reach: Set[str]
+) -> List[Finding]:
+    out: List[Finding] = []
+    for src in ctx.sources:
+        in_scope = src.is_hot or any(
+            fnmatch.fnmatch(src.rel, p) for p in _CAST_SCOPE_PATTERNS
+        )
+        if not in_scope:
+            continue
+        aliases = ctx.aliases[src.rel]
+        for key, info in ctx.graph.functions.items():
+            if info.src is not src or key in jit_reach:
+                continue
+            if ISLAND_TOKEN in _def_tokens(src, info.node):
+                continue
+            frameish = _frameish_locals(info.node)
+            env = project.env_for(key)
+
+            def is_frame_expr(node: ast.AST) -> bool:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and (
+                        _frameish(sub.id) or sub.id in frameish
+                    ):
+                        return True
+                    if isinstance(sub, ast.Attribute) and _frameish(sub.attr):
+                        return True
+                return False
+
+            for node in _own_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                recv: Optional[ast.AST] = None
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args
+                    and _is_host_f32(node.args[0], aliases)
+                ):
+                    recv = node.func.value
+                else:
+                    rd = resolve_dotted(node.func, aliases)
+                    if rd in _NP_WRAPPERS and node.args:
+                        dt = next(
+                            (kw.value for kw in node.keywords if kw.arg == "dtype"),
+                            node.args[1] if len(node.args) > 1 else None,
+                        )
+                        if dt is not None and _is_host_f32(dt, aliases):
+                            recv = node.args[0]
+                if recv is None or not is_frame_expr(recv):
+                    continue
+                if _islanded(src, None, node.lineno):
+                    continue
+                if project.expr_taint(recv, env, src, info).device:
+                    continue  # device value: the cast runs on-chip, not host
+                out.append(
+                    Finding(
+                        src.path, node.lineno, node.col_offset, RULES["GC803"],
+                        "host-side float32 cast on a frame payload in "
+                        f"{info.name!r}: 4x the uint8 wire bytes over H2D",
+                        _HINT_803,
+                    )
+                )
+    return out
+
+
+# --- GC804 parity-pin coverage ----------------------------------------------
+
+PARITY_BUDGET_BASENAME = "parity_budget.json"
+ADMISSION_TABLE_NAME = "LOW_PRECISION_MODEL_FAMILIES"
+_PARITY_ASSERT_TOKENS = ("assert_drift_within", "max_rel_drift")
+
+
+def _parse_admissions(st: ast.Assign) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    if not isinstance(st.value, ast.Dict):
+        return out
+    for k, v in zip(st.value.keys, st.value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue
+        fams: List[str] = []
+        if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    fams.append(el.value)
+        out[k.value] = fams
+    return out
+
+
+def _tests_dirs(anchor: str) -> List[str]:
+    cands = [
+        os.path.join(anchor, "tests"),
+        os.path.normpath(os.path.join(anchor, "..", "tests")),
+        os.path.normpath(os.path.join(package_root(), "..", "tests")),
+    ]
+    # nearest existing dir only: a project that carries its own tests/
+    # next to the analyzed file is judged by those tests, not by whatever
+    # this package's suite happens to mention
+    for c in cands:
+        if os.path.isdir(c):
+            return [c]
+    return []
+
+
+_TESTS_TEXT_CACHE: Dict[str, List[str]] = {}
+_TESTS_TEXT_LOCK = threading.Lock()
+
+
+def _tests_texts(dirs: Sequence[str]) -> List[str]:
+    texts: List[str] = []
+    with _TESTS_TEXT_LOCK:
+        for d in dirs:
+            if d not in _TESTS_TEXT_CACHE:
+                blobs: List[str] = []
+                try:
+                    names = sorted(os.listdir(d))
+                except OSError:
+                    names = []
+                for fn in names:
+                    if not fn.endswith(".py"):
+                        continue
+                    try:
+                        with open(
+                            os.path.join(d, fn), "r", encoding="utf-8"
+                        ) as fh:
+                            blobs.append(fh.read())
+                    except OSError:
+                        continue
+                _TESTS_TEXT_CACHE[d] = blobs
+            texts.extend(_TESTS_TEXT_CACHE[d])
+    return texts
+
+
+def _check_parity_coverage(sources: Sequence[SourceFile]) -> List[Finding]:
+    cfg = next((s for s in sources if s.rel == "config.py"), None)
+    if cfg is None:
+        return []
+    table: Optional[ast.Assign] = None
+    admitted: Dict[str, List[str]] = {}
+    for st in cfg.tree.body:
+        if isinstance(st, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == ADMISSION_TABLE_NAME
+            for t in st.targets
+        ):
+            table = st
+            admitted = _parse_admissions(st)
+    out: List[Finding] = []
+
+    def emit(line: int, msg: str) -> None:
+        out.append(Finding(cfg.path, line, 0, RULES["GC804"], msg, _HINT_804))
+
+    if table is None:
+        # only meaningful for a config that really carries the dtype
+        # axis (the fixture configs for other families do not)
+        if "--dtype" in cfg.text:
+            emit(
+                1,
+                f"config.py admits --dtype values but declares no "
+                f"{ADMISSION_TABLE_NAME} table for GC804 to check",
+            )
+        return out
+
+    budget_path = os.path.join(
+        os.path.dirname(cfg.path), "analysis", PARITY_BUDGET_BASENAME
+    )
+    if not os.path.isfile(budget_path):
+        emit(
+            table.lineno,
+            f"{ADMISSION_TABLE_NAME} admits low-precision dtypes but no "
+            f"analysis/{PARITY_BUDGET_BASENAME} is committed",
+        )
+        return out
+    try:
+        with open(budget_path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        emit(table.lineno, f"unreadable {PARITY_BUDGET_BASENAME}: {e}")
+        return out
+    families = {
+        k: v for k, v in doc.items() if not k.startswith("_") and isinstance(v, dict)
+    }
+
+    tests = _tests_texts(_tests_dirs(os.path.dirname(cfg.path)))
+    for dtype, fams in admitted.items():
+        for fam in fams:
+            entry = families.get(fam, {}).get(dtype)
+            kinds = entry if isinstance(entry, dict) else {}
+            bounded = any(
+                isinstance(spec, dict)
+                and isinstance(spec.get("max_rel"), (int, float))
+                for spec in kinds.values()
+            )
+            if not bounded:
+                emit(
+                    table.lineno,
+                    f"admitted ({fam!r}, {dtype!r}) has no max_rel drift "
+                    f"budget in {PARITY_BUDGET_BASENAME}",
+                )
+                continue
+            asserted = any(
+                any(tok in txt for tok in _PARITY_ASSERT_TOKENS)
+                and (f'"{fam}"' in txt or f"'{fam}'" in txt)
+                and (f'"{dtype}"' in txt or f"'{dtype}'" in txt)
+                for txt in tests
+            )
+            if not asserted:
+                emit(
+                    table.lineno,
+                    f"admitted ({fam!r}, {dtype!r}) has a parity budget but "
+                    "no e2e test asserts it "
+                    f"({'/'.join(_PARITY_ASSERT_TOKENS)} in tests/)",
+                )
+    for fam, dmap in families.items():
+        for dtype in dmap:
+            if fam not in admitted.get(dtype, ()):
+                emit(
+                    table.lineno,
+                    f"orphan parity budget ({fam!r}, {dtype!r}): "
+                    f"{ADMISSION_TABLE_NAME} no longer admits it",
+                )
+    return out
+
+
+# --- GC805 pallas hygiene ---------------------------------------------------
+
+def _pallas_scope(src: SourceFile) -> bool:
+    return (
+        src.rel.startswith("ops/pallas/") and not src.rel.endswith("__init__.py")
+    ) or PALLAS_MARKER in src.markers
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _seq_elts(node: Optional[ast.AST]) -> List[ast.AST]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return list(node.elts)
+    return [] if node is None else [node]
+
+
+def _scratch_dtype(node: ast.AST, aliases: Dict[str, str]) -> Optional[ast.AST]:
+    """The dtype arg of a ``pltpu.VMEM(shape, dtype)`` scratch spec; None
+    for non-VMEM entries (semaphores etc. carry no accumulator risk)."""
+    if isinstance(node, ast.Call):
+        rd = resolve_dotted(node.func, aliases)
+        if rd is not None and rd.endswith(".VMEM") and len(node.args) >= 2:
+            return node.args[1]
+    return None
+
+
+def _resolve_kernel(
+    arg: ast.AST, src: SourceFile, info: Optional[FunctionInfo], graph: CallGraph
+) -> Optional[FunctionInfo]:
+    keys, _ = graph.resolve_call(arg, src, info)
+    for k in keys:
+        return graph.functions[k]
+    # the idiomatic wrappers bind the kernel through a local first:
+    #   kernel = functools.partial(_kernel, disp=...); pl.pallas_call(kernel, ...)
+    # resolve_call treats a bare local Name as opaque, so chase the
+    # single-target assignment ourselves (resolve_call unwraps partial).
+    if isinstance(arg, ast.Name) and info is not None:
+        for st in _own_nodes(info.node):
+            if (
+                isinstance(st, ast.Assign)
+                and len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Name)
+                and st.targets[0].id == arg.id
+            ):
+                keys, _ = graph.resolve_call(st.value, src, info)
+                for k in keys:
+                    return graph.functions[k]
+    return None
+
+
+def _check_pallas(ctx: _Ctx) -> List[Finding]:
+    out: List[Finding] = []
+    graph = ctx.graph
+    for src in ctx.sources:
+        if not _pallas_scope(src):
+            continue
+        aliases = ctx.aliases[src.rel]
+        for key, info in graph.functions.items():
+            if info.src is not src:
+                continue
+            for node in _own_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                rd = resolve_dotted(node.func, aliases)
+                if rd is None or not (
+                    rd == "pallas_call" or rd.endswith(".pallas_call")
+                ):
+                    continue
+                kernel = (
+                    _resolve_kernel(node.args[0], src, info, graph)
+                    if node.args
+                    else None
+                )
+                out.extend(
+                    _pallas_site(ctx, src, aliases, info, node, kernel)
+                )
+    return out
+
+
+def _pallas_site(
+    ctx: _Ctx,
+    src: SourceFile,
+    aliases: Dict[str, str],
+    wrapper: FunctionInfo,
+    call: ast.Call,
+    kernel: Optional[FunctionInfo],
+) -> List[Finding]:
+    out: List[Finding] = []
+
+    def emit(line: int, col: int, msg: str) -> None:
+        out.append(Finding(src.path, line, col, RULES["GC805"], msg, _HINT_805))
+
+    # --- wrapper-side: grid divisibility + interpret exposure ---------------
+    wnode = wrapper.node
+    has_mod_guard = any(
+        isinstance(n, ast.If)
+        and any(
+            isinstance(s, ast.BinOp) and isinstance(s.op, ast.Mod)
+            for s in ast.walk(n.test)
+        )
+        for n in _own_nodes(wnode)
+    )
+    has_pad = any(
+        isinstance(n, ast.Call)
+        and (resolve_dotted(n.func, aliases) or "").endswith(".pad")
+        for n in _own_nodes(wnode)
+    )
+    for elt in _seq_elts(_kw(call, "grid")):
+        if isinstance(elt, ast.BinOp) and isinstance(elt.op, ast.FloorDiv):
+            if not has_mod_guard and not _islanded(src, None, elt.lineno):
+                emit(
+                    elt.lineno, elt.col_offset,
+                    f"grid dimension `//` in {wrapper.name!r} with no "
+                    "divisibility guard: a remainder silently drops rows",
+                )
+        elif isinstance(elt, ast.Call) and (
+            resolve_dotted(elt.func, aliases) or ""
+        ).endswith(".cdiv"):
+            if not (has_pad or has_mod_guard):
+                emit(
+                    elt.lineno, elt.col_offset,
+                    f"cdiv grid in {wrapper.name!r} rounds up but nothing "
+                    "pads or guards the remainder rows",
+                )
+    if "interpret" not in param_names(wnode):
+        emit(
+            wnode.lineno, wnode.col_offset,
+            f"kernel wrapper {wrapper.name!r} exposes no interpret= "
+            "parameter: CPU parity tests cannot drive it",
+        )
+    else:
+        dirs = _tests_dirs(os.path.dirname(src.path))
+        texts = _tests_texts(dirs)
+        tested = any(
+            wrapper.name in txt and "interpret=True" in txt for txt in texts
+        )
+        if not tested:
+            emit(
+                wnode.lineno, wnode.col_offset,
+                f"no interpret-mode parity test exercises {wrapper.name!r} "
+                "(need `interpret=True` + the wrapper name under tests/)",
+            )
+
+    # --- kernel-side: accumulator dtypes + dot/reduction pins ---------------
+    if kernel is None:
+        return out
+    knode = kernel.node
+    params = [a.arg for a in knode.args.posonlyargs + knode.args.args]
+    scratch_elts = _seq_elts(_kw(call, "scratch_shapes"))
+    n_scratch = len(scratch_elts)
+    scratch_of: Dict[str, ast.AST] = {}
+    if n_scratch and len(params) >= n_scratch:
+        for p, spec in zip(params[-n_scratch:], scratch_elts):
+            scratch_of[p] = spec
+
+    loads: Dict[str, str] = {}  # local name -> param it loads from
+    for n in _own_nodes(knode):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Subscript):
+            base = n.value.value
+            if isinstance(base, ast.Name) and base.id in params:
+                for tgt in n.targets:
+                    for nm in _target_names(tgt):
+                        loads[nm] = base.id
+
+    # names loaded from f32 VMEM scratch seed the kernel's pinned set
+    f32_scratch: Set[str] = set()
+    for p, spec in scratch_of.items():
+        dt = _scratch_dtype(spec, aliases)
+        if dt is not None and _is_f32_dtype(dt, aliases):
+            f32_scratch.add(p)
+    seed = {nm for nm, p in loads.items() if p in f32_scratch}
+    khighs = _highest_names(knode, aliases)
+    pinned = _pinned_names(knode, aliases, seed=seed, highs=khighs)
+
+    def subscript_writes(n: ast.AST) -> Optional[str]:
+        tgt = None
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            tgt = n.targets[0]
+        elif isinstance(n, ast.AugAssign):
+            tgt = n.target
+        if isinstance(tgt, ast.Subscript) and isinstance(tgt.value, ast.Name):
+            if tgt.value.id in params:
+                return tgt.value.id
+        return None
+
+    for n in _own_nodes(knode):
+        p = subscript_writes(n)
+        if p is not None:
+            value = n.value
+            rmw = isinstance(n, ast.AugAssign)
+            if not rmw and value is not None:
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Subscript) and isinstance(
+                        sub.value, ast.Name
+                    ) and sub.value.id == p:
+                        rmw = True
+                        break
+                    if isinstance(sub, ast.Name) and loads.get(sub.id) == p:
+                        rmw = True
+                        break
+            if rmw and not _islanded(src, None, n.lineno):
+                if p in scratch_of:
+                    dt = _scratch_dtype(scratch_of[p], aliases)
+                    if dt is not None and not _is_f32_dtype(dt, aliases):
+                        emit(
+                            dt.lineno, dt.col_offset,
+                            f"accumulator scratch {p!r} of kernel "
+                            f"{kernel.name!r} is not float32",
+                        )
+                else:
+                    emit(
+                        n.lineno, n.col_offset,
+                        f"kernel {kernel.name!r} accumulates into "
+                        f"non-scratch ref {p!r}: carry partial sums in "
+                        "float32 VMEM scratch and store once",
+                    )
+            continue
+        if not isinstance(n, ast.Call):
+            continue
+        rd = resolve_dotted(n.func, aliases)
+        kind: Optional[str] = None
+        operands: List[ast.AST] = []
+        if rd in _MATMUL:
+            kind = rd.rsplit(".", 1)[-1]
+            operands = _operands(n, rd)
+        elif rd in _KERNEL_REDUCTIONS:
+            kind = rd.rsplit(".", 1)[-1]
+            operands = list(n.args)
+        elif (
+            isinstance(n.func, ast.Attribute)
+            and n.func.attr in _KERNEL_REDUCTION_METHODS
+            and isinstance(n.func.value, ast.Name)
+        ):
+            kind = f".{n.func.attr}()"
+            operands = [n.func.value]
+        if kind is None or _islanded(src, None, n.lineno):
+            continue
+        if _call_has_pin(n, aliases, khighs):
+            continue
+        if any(_pinning_expr(a, aliases, pinned, khighs) for a in operands):
+            continue
+        emit(
+            n.lineno, n.col_offset,
+            f"{kind} in kernel {kernel.name!r} accumulates in the input "
+            "dtype (bf16 inputs lose the sum)",
+        )
+    return out
+
+
+# --- family entry -----------------------------------------------------------
+
+def check(
+    sources: Sequence[SourceFile], graph: CallGraph, project: ProjectTaint
+) -> List[Finding]:
+    ctx = _Ctx(sources, graph)
+    findings: List[Finding] = []
+    findings.extend(_check_promotion(ctx))
+    findings.extend(_check_accum(ctx))
+    jit_reach = set(ctx.reach(sorted(_jit_roots(ctx))))
+    findings.extend(_check_cast_discipline(ctx, project, jit_reach))
+    findings.extend(_check_parity_coverage(sources))
+    findings.extend(_check_pallas(ctx))
+    return findings

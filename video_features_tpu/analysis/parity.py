@@ -1,0 +1,395 @@
+"""Parity budgets — the committed (family, dtype) max-drift table.
+
+``parity_budget.json`` is ``compile_budget.json``'s numerics twin: for
+every low-precision (model family, dtype) pair that
+``config.LOW_PRECISION_MODEL_FAMILIES`` admits, it commits a ceiling on
+relative-L2 feature drift versus the fp32 graph. GC804
+(analysis/numerics.py) cross-checks the two tables and requires an e2e
+test to assert each pair through :func:`assert_drift_within` /
+:func:`max_rel_drift` — so an admission with no committed bound, a
+bound with no test, or an orphan budget entry all fail
+``python -m video_features_tpu.analysis``.
+
+The ``measured`` column is regenerated, never hand-edited:
+``python -m video_features_tpu.analysis --update-budgets --scenario
+parity_<family>`` re-runs the family's drift scenarios (random init,
+CPU, deterministic seeds — the same regime the tier-1 tests pin) and
+rewrites ``measured`` in place. ``max_rel`` is the committed contract:
+the writer only fills it when absent (1.5x headroom over measured);
+raising an existing ceiling is a reviewed diff, exactly like GC401.
+
+Budget document shape::
+
+    {"_meta": {...},
+     "<family>": {"<dtype>": {"<kind>": {"max_rel": 0.03,
+                                         "measured": 0.0104}}}}
+
+``kind`` names the measurement surface: ``model`` (one forward pass at
+full channel width), ``e2e`` (the extractor pipeline end to end),
+``e2e_flow`` (the I3D flow stream with RAFT in the loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+from typing import Callable, Dict, Optional, Sequence
+
+PARITY_BUDGET_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "parity_budget.json"
+)
+
+# headroom multiplier used ONLY when --update-budgets fills a ceiling
+# that was never committed; existing max_rel values are never touched
+_FILL_HEADROOM = 1.5
+
+
+def load_parity_budget(path: Optional[str] = None) -> Dict:
+    with open(path or PARITY_BUDGET_PATH, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def max_rel_drift(
+    family: str, dtype: str, kind: str, path: Optional[str] = None
+) -> float:
+    """The committed drift ceiling, or a KeyError that tells you how to
+    commit one (the GC804 contract: no budget, no admission)."""
+    doc = load_parity_budget(path)
+    try:
+        spec = doc[family][dtype][kind]
+        return float(spec["max_rel"])
+    except (KeyError, TypeError):
+        raise KeyError(
+            f"no parity budget for ({family!r}, {dtype!r}, {kind!r}) in "
+            f"{PARITY_BUDGET_PATH}: commit a max_rel ceiling (regenerate "
+            f"measured drift with --update-budgets --scenario "
+            f"parity_{family})"
+        ) from None
+
+
+def rel_drift(low, ref) -> float:
+    """Relative L2: ||low - ref|| / ||ref||, in float64."""
+    import numpy as np
+
+    low = np.asarray(low, np.float64)
+    ref = np.asarray(ref, np.float64)
+    return float(np.linalg.norm(low - ref) / max(np.linalg.norm(ref), 1e-12))
+
+
+def assert_drift_within(
+    family: str,
+    dtype: str,
+    kind: str,
+    low,
+    ref,
+    path: Optional[str] = None,
+) -> float:
+    """Assert ``rel_drift(low, ref)`` stays under the committed ceiling;
+    returns the measured drift so tests can also pin a nonzero floor
+    (identical outputs would mean the low-precision graph never ran)."""
+    ceiling = max_rel_drift(family, dtype, kind, path=path)
+    measured = rel_drift(low, ref)
+    assert measured <= ceiling, (
+        f"({family}, {dtype}, {kind}) drift {measured:.5f} exceeds the "
+        f"committed parity budget {ceiling} — if the numerics change is "
+        f"intentional, regenerate with --update-budgets --scenario "
+        f"parity_{family} and commit the new ceiling"
+    )
+    return measured
+
+
+# --- measurement scenarios ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ParityScenario:
+    """One family's drift measurements: runner returns {kind: rel_drift}."""
+
+    family: str
+    dtype: str
+    description: str
+    runner: Callable[[str], Dict[str, float]]  # tmp dir -> measured drift
+
+
+def _model_drift_clip() -> float:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.clip.model import (
+        CLIP_VIT_B32,
+        VisionTransformer,
+        init_params,
+    )
+    from video_features_tpu.models.common.weights import (
+        cast_floats_for_compute,
+    )
+
+    params = init_params(CLIP_VIT_B32)
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32)
+    )
+    ref = VisionTransformer(CLIP_VIT_B32).apply({"params": params}, x)
+    p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("proj",))
+    out = VisionTransformer(CLIP_VIT_B32, dtype=jnp.bfloat16).apply(
+        {"params": p16}, x
+    )
+    return rel_drift(out, ref)
+
+
+def _model_drift_resnet() -> float:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.common.weights import (
+        cast_floats_for_compute,
+    )
+    from video_features_tpu.models.resnet.model import build, init_params
+
+    params = init_params("resnet50")
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32)
+    )
+    ref, _ = build("resnet50").apply({"params": params}, x)
+    p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("fc",))
+    out, _ = build("resnet50", dtype=jnp.bfloat16).apply({"params": p16}, x)
+    return rel_drift(out, ref)
+
+
+def _model_drift_r21d() -> float:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.common.weights import (
+        cast_floats_for_compute,
+    )
+    from video_features_tpu.models.r21d.model import build, init_params
+
+    params = init_params()
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(1, 8, 112, 112, 3).astype(np.float32)
+    )
+    ref, _ = build().apply({"params": params}, x)
+    p16 = cast_floats_for_compute(params, jnp.bfloat16, exclude=("fc",))
+    out, _ = build(dtype=jnp.bfloat16).apply({"params": p16}, x)
+    return rel_drift(out, ref)
+
+
+def _model_drift_i3d() -> float:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.common.weights import (
+        cast_floats_for_compute,
+    )
+    from video_features_tpu.models.i3d.model import build, init_params
+
+    params = init_params("rgb")
+    x = jnp.asarray(
+        np.random.RandomState(0)
+        .uniform(-1, 1, (1, 16, 224, 224, 3))
+        .astype(np.float32)
+    )
+    ref, _ = build().apply({"params": params}, x)
+    p16 = cast_floats_for_compute(
+        params, jnp.bfloat16, exclude=("conv3d_0c_1x1",)
+    )
+    out, _ = build(dtype=jnp.bfloat16).apply({"params": p16}, x)
+    return rel_drift(out, ref)
+
+
+def _flow_frames():
+    """The tests' coherent-motion pair: frame 2 is frame 1 shifted
+    (3, 2) px, 128x128, grayscale replicated to RGB."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    H = W = 128
+    rng = np.random.RandomState(0)
+    base = rng.uniform(0, 255, size=(H + 8, W + 8)).astype(np.float32)
+    f1 = base[4 : 4 + H, 4 : 4 + W]
+    f2 = base[1 : 1 + H, 2 : 2 + W]
+    return jnp.asarray(
+        np.stack([np.stack([f1] * 3, -1), np.stack([f2] * 3, -1)])
+    )
+
+
+def _model_drift_flow(ft: str) -> float:
+    import numpy as np
+    import jax.numpy as jnp
+
+    if ft == "raft":
+        from video_features_tpu.models.raft.model import build, init_params
+    else:
+        from video_features_tpu.models.pwc.model import build, init_params
+
+    frames = _flow_frames()
+    params = init_params()
+    f32 = np.asarray(build(dtype=jnp.float32).apply({"params": params}, frames))
+    f16 = np.asarray(
+        build(dtype=jnp.bfloat16).apply({"params": params}, frames)
+    )
+    return rel_drift(f16, f32)
+
+
+def _e2e_features(tmp: str, ft: str, dtype: str, **overrides):
+    from video_features_tpu.config import ExtractionConfig, sanity_check
+    from video_features_tpu.extract.registry import build_extractor
+
+    cfg = sanity_check(
+        ExtractionConfig(
+            allow_random_init=True,
+            feature_type=ft,
+            dtype=dtype,
+            tmp_path=os.path.join(tmp, f"tmp_{dtype}"),
+            output_path=os.path.join(tmp, f"out_{dtype}"),
+            cpu=True,
+            **overrides,
+        )
+    )
+    ex = build_extractor(cfg, external_call=True)
+    ex.progress.disable = True
+    return ex([0])[0]
+
+
+def _e2e_drift_clip(tmp: str) -> float:
+    from video_features_tpu.utils.synth import synth_video
+
+    video = synth_video(os.path.join(tmp, "clip.mp4"), n_frames=24,
+                        width=320, height=240, seed=0)
+    kw = dict(
+        video_paths=[video], extract_method="uni_4", preprocess="device"
+    )
+    f32 = _e2e_features(tmp, "CLIP-ViT-B/32", "float32", **kw)
+    bf16 = _e2e_features(tmp, "CLIP-ViT-B/32", "bfloat16", **kw)
+    return rel_drift(bf16["CLIP-ViT-B/32"], f32["CLIP-ViT-B/32"])
+
+
+def _e2e_drift_flow(tmp: str, ft: str) -> float:
+    from video_features_tpu.utils.synth import synth_video
+
+    video = synth_video(os.path.join(tmp, f"{ft}.mp4"), n_frames=8,
+                        width=100, height=96, seed=3)
+    kw = dict(video_paths=[video], batch_size=4, preprocess="device")
+    f32 = _e2e_features(tmp, ft, "float32", **kw)
+    bf16 = _e2e_features(tmp, ft, "bfloat16", **kw)
+    return rel_drift(bf16[ft], f32[ft])
+
+
+def _e2e_drift_i3d_flow(tmp: str) -> float:
+    from video_features_tpu.utils.synth import synth_video
+
+    video = synth_video(os.path.join(tmp, "i3d.mp4"))  # 60f 320x240
+    kw = dict(
+        video_paths=[video],
+        streams=["flow"],
+        flow_type="raft",
+        extraction_fps=5.0,
+        stack_size=10,
+        step_size=10,
+    )
+    f32 = _e2e_features(tmp, "i3d", "float32", **kw)
+    bf16 = _e2e_features(tmp, "i3d", "bfloat16", **kw)
+    return rel_drift(bf16["flow"], f32["flow"])
+
+
+PARITY_SCENARIOS: Dict[str, ParityScenario] = {
+    "parity_clip": ParityScenario(
+        family="clip", dtype="bfloat16",
+        description=(
+            "CLIP ViT-B/32 bf16 vs f32: one full-width forward (model) + "
+            "the uni_4 device-preprocess extraction (e2e), random init."
+        ),
+        runner=lambda tmp: {
+            "model": _model_drift_clip(),
+            "e2e": _e2e_drift_clip(tmp),
+        },
+    ),
+    "parity_resnet": ParityScenario(
+        family="resnet", dtype="bfloat16",
+        description="ResNet-50 bf16 vs f32 full-width forward, random init.",
+        runner=lambda tmp: {"model": _model_drift_resnet()},
+    ),
+    "parity_r21d": ParityScenario(
+        family="r21d", dtype="bfloat16",
+        description="R(2+1)D bf16 vs f32 full-width forward, random init.",
+        runner=lambda tmp: {"model": _model_drift_r21d()},
+    ),
+    "parity_i3d": ParityScenario(
+        family="i3d", dtype="bfloat16",
+        description=(
+            "I3D bf16 vs f32: RGB forward (model) + the RAFT flow-stream "
+            "extraction with both nets bf16 (e2e_flow), random init."
+        ),
+        runner=lambda tmp: {
+            "model": _model_drift_i3d(),
+            "e2e_flow": _e2e_drift_i3d_flow(tmp),
+        },
+    ),
+    "parity_raft": ParityScenario(
+        family="raft", dtype="bfloat16",
+        description=(
+            "RAFT bf16 vs f32: coherent-motion forward at 128x128 (model) "
+            "+ the standalone flow extraction on the tiny corpus (e2e)."
+        ),
+        runner=lambda tmp: {
+            "model": _model_drift_flow("raft"),
+            "e2e": _e2e_drift_flow(tmp, "raft"),
+        },
+    ),
+    "parity_pwc": ParityScenario(
+        family="pwc", dtype="bfloat16",
+        description=(
+            "PWC-Net bf16 vs f32: coherent-motion forward at 128x128 "
+            "(model) + the standalone flow extraction (e2e)."
+        ),
+        runner=lambda tmp: {
+            "model": _model_drift_flow("pwc"),
+            "e2e": _e2e_drift_flow(tmp, "pwc"),
+        },
+    ),
+}
+
+
+def measure_parity(name: str) -> Dict[str, float]:
+    sc = PARITY_SCENARIOS[name]
+    with tempfile.TemporaryDirectory(prefix=f"graftcheck_{name}_") as tmp:
+        return {k: float(v) for k, v in sc.runner(tmp).items()}
+
+
+def update_parity_budgets(names: Optional[Sequence[str]] = None) -> int:
+    """Re-measure drift and rewrite the ``measured`` column of
+    ``parity_budget.json``. Committed ``max_rel`` ceilings are preserved;
+    a ceiling is only filled in (with ``_FILL_HEADROOM`` headroom) when
+    the entry never had one. Returns a process exit code."""
+    chosen = list(names) if names else sorted(PARITY_SCENARIOS)
+    unknown = [n for n in chosen if n not in PARITY_SCENARIOS]
+    if unknown:
+        print(
+            f"graftcheck: unknown parity scenario(s): {', '.join(unknown)} "
+            f"(have: {', '.join(sorted(PARITY_SCENARIOS))})",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        doc = load_parity_budget()
+    except OSError:
+        doc = {}
+    for name in chosen:
+        sc = PARITY_SCENARIOS[name]
+        drifts = measure_parity(name)
+        slot = doc.setdefault(sc.family, {}).setdefault(sc.dtype, {})
+        for kind, measured in sorted(drifts.items()):
+            entry = slot.setdefault(kind, {})
+            entry["measured"] = round(measured, 6)
+            if "max_rel" not in entry:
+                entry["max_rel"] = round(measured * _FILL_HEADROOM, 4)
+        pretty = ", ".join(f"{k}={v:.5f}" for k, v in sorted(drifts.items()))
+        print(f"graftcheck: {name}: {pretty}")
+    with open(PARITY_BUDGET_PATH, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"graftcheck: wrote {PARITY_BUDGET_PATH}")
+    return 0

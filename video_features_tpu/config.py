@@ -57,6 +57,30 @@ DEVICE_PREPROCESS_FEATURE_TYPES = (
 # this list against the declared entries.
 MESH_DEVICE_PREPROCESS_FEATURE_TYPES = CLIP_FEATURE_TYPES + ["raft", "pwc", "i3d"]
 
+# --dtype admission table (graftcheck GC804): model families whose
+# low-precision graphs carry a committed relative-drift ceiling in
+# analysis/parity_budget.json, each asserted end-to-end in tests/.
+# sanity_check rejects low-precision dtypes for any family not listed
+# here, and GC804 cross-checks this table against the budget file — so
+# an admission, its ceiling, and its parity test land in one diff.
+# VGGish stays fp32-only (the audio net is too small for bf16 to buy
+# anything).
+LOW_PRECISION_MODEL_FAMILIES = {
+    "bfloat16": ("clip", "resnet", "r21d", "i3d", "raft", "pwc"),
+}
+
+
+def model_family(feature_type: str) -> str:
+    """The parity/admission family of a feature type ('resnet50' ->
+    'resnet', 'CLIP-ViT-B/16' -> 'clip', 'r21d_rgb' -> 'r21d')."""
+    if feature_type in CLIP_FEATURE_TYPES:
+        return "clip"
+    if feature_type in RESNET_FEATURE_TYPES:
+        return "resnet"
+    if feature_type == "r21d_rgb":
+        return "r21d"
+    return feature_type
+
 
 @dataclass
 class ExtractionConfig:
@@ -102,10 +126,12 @@ class ExtractionConfig:
 
     # --- TPU-native knobs (no reference equivalent) ---
     # Numerics: 'float32' for parity with the fp32 reference; 'bfloat16'
-    # runs CLIP/ResNet/R21D/I3D conv+matmul stacks in bf16 (LayerNorm,
-    # softmax, BatchNorm math and the feature heads stay fp32; ~1e-2
-    # relative feature drift — tests/test_bfloat16.py). RAFT/PWC/VGGish
-    # intentionally ignore it (iterative flow refinement compounds drift).
+    # runs the conv/matmul stacks of every LOW_PRECISION_MODEL_FAMILIES
+    # family in bf16 — including RAFT/PWC since r4 (LayerNorm, softmax,
+    # BatchNorm math, flow refinement carries/corr pyramids and the
+    # feature heads stay fp32). Per-family drift ceilings live in
+    # analysis/parity_budget.json and are asserted by the parity tests;
+    # sanity_check rejects the flag for unadmitted families (vggish*).
     dtype: str = "float32"
     # Path to converted model weights (.npz / orbax dir). Absent or
     # incomplete weights are a hard error unless allow_random_init is set
@@ -397,6 +423,19 @@ def sanity_check(cfg: ExtractionConfig) -> ExtractionConfig:
                 f"frame-level extractors: {supported} "
                 f"(got {cfg.feature_type!r}; windowed/flow models mix "
                 "frames across time)"
+            )
+    if cfg.dtype != "float32":
+        fams = LOW_PRECISION_MODEL_FAMILIES.get(cfg.dtype)
+        if fams is None:
+            raise ValueError(f"unknown dtype: {cfg.dtype!r}")
+        if model_family(cfg.feature_type) not in fams:
+            raise ValueError(
+                f"--dtype {cfg.dtype} is not admitted for "
+                f"{cfg.feature_type!r}: admission requires a committed "
+                "drift ceiling in analysis/parity_budget.json plus an "
+                "e2e parity test (graftcheck GC804) — see "
+                "LOW_PRECISION_MODEL_FAMILIES and docs/tpu.md "
+                "'Precision contract'"
             )
     if cfg.attn not in ("fused", "flash", "blockwise"):
         raise ValueError(f"unknown attn core: {cfg.attn}")

@@ -652,6 +652,9 @@ class ExtractI3D(BaseExtractor):
                 raise ValueError(f"flow pair mismatch: {x.name} vs {y.name}")
         return list(zip(xs, ys))
 
+    # graftcheck: fp32-island — precomputed-flow ingest: grayscale JPEGs
+    # already encode clamped TV-L1 flow, decoded float here for the
+    # [-20, 20] un-mapping; this input mode never takes the uint8 wire
     def _read_flow_images(self, flow_dir: str, pairs=None) -> np.ndarray:
         """Decode every flow JPEG pair ONCE -> (N, H, W, 2) float32 (the
         windows may overlap when step < stack; re-decoding per window
@@ -723,6 +726,9 @@ class ExtractI3D(BaseExtractor):
             return 0
         return len(pairs) * (h * w * 2 * 4) // self._FRAME_BYTES
 
+    # graftcheck: fp32-island — host PIL-parity decode (--preprocess host):
+    # pil_resize wants float pixels; the production path is _decode_raw,
+    # which ships uint8 and resizes on device (4x fewer wire bytes)
     def _decode_resized(self, video_path, meta=None):
         frames, fps, timestamps_ms = self._sample_frames(video_path, meta)
         if not frames:

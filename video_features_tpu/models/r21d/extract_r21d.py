@@ -147,12 +147,17 @@ class ExtractR21D(BaseExtractor):
     # window batch's transfer + fused preprocess/forward is dispatched
     # (async under XLA), results stay on device until fetch — the next
     # video's dispatches overlap this video's fetch
-    def _maybe_widen(self, x: np.ndarray) -> np.ndarray:
+    # graftcheck: fp32-island — the documented --uint8_transfer=off escape
+    # hatch: it exists to trade the 4x wire bytes for a slow-uint8-DMA
+    # transport, so the host cast here is the feature, not a leak
+    def _maybe_widen(self, frames: np.ndarray) -> np.ndarray:
         """--uint8_transfer off: pre-cast windows to fp32 host-side — the
         escape hatch for transports with a slow uint8 DMA path
         (config.py). kinetics_preprocess starts with an fp32 cast, so
         numerics are identical either way."""
-        return x.astype(np.float32) if self.config.uint8_transfer == "off" else x
+        if self.config.uint8_transfer == "off":
+            return frames.astype(np.float32)
+        return frames
 
     def dispatch_prepared(self, device, state, path_entry, payload):
         batches, slices = payload

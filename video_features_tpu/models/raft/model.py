@@ -300,7 +300,12 @@ def upsample_flow(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Convex-combination 8x upsampling (ref raft_src/raft.py:102-111):
     softmax over 9 neighbors, weights per output subpixel of each cell."""
     N, H, W, _ = flow.shape
-    mask = jax.nn.softmax(mask.reshape(N, H, W, 9, 8, 8), axis=3)
+    # fp32 pin (GC802): the convex weights are a 9-way softmax whose
+    # renormalization cannot survive bf16; the GRU head keeps mask fp32
+    # today and this cast makes that contract load-bearing.
+    mask = jax.nn.softmax(
+        mask.reshape(N, H, W, 9, 8, 8).astype(jnp.float32), axis=3
+    )
     f = jnp.pad(8.0 * flow, ((0, 0), (1, 1), (1, 1), (0, 0)))
     patches = jnp.stack(
         [f[:, ky : ky + H, kx : kx + W, :] for ky in range(3) for kx in range(3)],

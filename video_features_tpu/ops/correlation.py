@@ -120,5 +120,8 @@ def local_correlation(
     for dy in range(-d, d + 1):
         for dx in range(-d, d + 1):
             shifted = f2p[:, :, d + dy : d + dy + H, d + dx : d + dx + W]
-            planes.append(jnp.mean(fmap1 * shifted, axis=1))
-    return jnp.stack(planes, axis=1)
+            # fp32 accumulation pin (GC802): the C-wide mean must not
+            # round per-step under bf16 fmaps; cast back once at the end
+            # so both correlation methods return the input dtype.
+            planes.append(jnp.mean(fmap1 * shifted, axis=1, dtype=jnp.float32))
+    return jnp.stack(planes, axis=1).astype(fmap1.dtype)

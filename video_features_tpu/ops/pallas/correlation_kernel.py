@@ -58,8 +58,10 @@ def _kernel(f1_ref, f2p_ref, out_ref, f2_tile, sem, *, disp: int, tile_h: int):
     for dy in range(disp):
         for dx in range(disp):
             f2 = f2_tile[:, dy : dy + tile_h, dx : dx + W]  # (C, TH, W)
-            planes.append(jnp.sum(f1 * f2, axis=0) / C)  # /C: exact mean
-    out_ref[0] = jnp.stack(planes, axis=0)  # (disp^2, TH, W)
+            # fp32 accumulation pin (GC805): the C-wide sum must not
+            # round per-step when the fmaps arrive bf16; /C: exact mean
+            planes.append(jnp.sum(f1 * f2, axis=0, dtype=jnp.float32) / C)
+    out_ref[0] = jnp.stack(planes, axis=0).astype(out_ref.dtype)  # (disp^2, TH, W)
 
 
 @functools.partial(

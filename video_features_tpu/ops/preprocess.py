@@ -71,6 +71,9 @@ def pil_center_crop(img: np.ndarray, crop: int) -> np.ndarray:
     return img[top : top + crop, left : left + crop]
 
 
+# graftcheck: fp32-island — torchvision ToTensor parity reference: the
+# production wire ships uint8 and casts on device (--preprocess device);
+# this host float path exists to pin that device graph bit-for-bit.
 def to_float_chw(img: np.ndarray) -> np.ndarray:
     """HWC uint8 -> CHW float32 in [0, 1] (torchvision ToTensor)."""
     return np.transpose(img, (2, 0, 1)).astype(np.float32) / 255.0
